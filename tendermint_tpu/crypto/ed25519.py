@@ -1,0 +1,236 @@
+"""Ed25519: scalar (CPU) reference implementation + key types.
+
+This is the framework's bit-exact reference path. Accept/reject semantics
+mirror Go's crypto/ed25519 (which the reference uses via
+golang.org/x/crypto/ed25519 — reference: crypto/ed25519/ed25519.go:9,148):
+
+  1. signature must be 64 bytes and S strictly canonical (S < L);
+  2. the public key A must decode per RFC 8032 (y < p, x recoverable,
+     and not (x == 0 with sign bit set));
+  3. h = SHA-512(R || A || msg) reduced mod L;
+  4. accept iff encode([S]B - [h]A) == sig[:32] byte-for-byte
+     (R itself is never decoded — non-canonical R bytes fail the compare).
+
+The TPU batched kernel (tendermint_tpu.ops.ed25519_kernel) is property-tested
+against this module for identical accept/reject decisions.
+
+Key formats follow the reference: 32-byte public keys, 64-byte private keys
+(seed || public), 20-byte addresses = SHA-256(pub)[:20]
+(reference: crypto/ed25519/ed25519.go, crypto/tmhash/hash.go).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass
+
+from tendermint_tpu.crypto import keys as _keys
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)  # sqrt(-1) mod p
+
+PUBKEY_SIZE = 32
+PRIVKEY_SIZE = 64
+SEED_SIZE = 32
+SIGNATURE_SIZE = 64
+
+KEY_TYPE = "ed25519"
+
+
+def _inv(x: int) -> int:
+    return pow(x, P - 2, P)
+
+
+# Extended homogeneous coordinates (X, Y, Z, T) with x=X/Z, y=Y/Z, xy=T/Z.
+_IDENT = (0, 1, 1, 0)
+
+
+def _add(p, q):
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    A = (Y1 - X1) * (Y2 - X2) % P
+    B = (Y1 + X1) * (Y2 + X2) % P
+    C = 2 * T1 * T2 * D % P
+    Dd = 2 * Z1 * Z2 % P
+    E, F, G, H = B - A, Dd - C, Dd + C, B + A
+    return (E * F % P, G * H % P, F * G % P, E * H % P)
+
+
+def _double(p):
+    X1, Y1, Z1, _ = p
+    A = X1 * X1 % P
+    B = Y1 * Y1 % P
+    C = 2 * Z1 * Z1 % P
+    H = A + B
+    E = H - (X1 + Y1) * (X1 + Y1) % P
+    G = A - B
+    F = C + G
+    return (E * F % P, G * H % P, F * G % P, E * H % P)
+
+
+def _scalarmult(s: int, p):
+    q = _IDENT
+    while s:
+        if s & 1:
+            q = _add(q, p)
+        p = _double(p)
+        s >>= 1
+    return q
+
+
+def _compress(p) -> bytes:
+    X, Y, Z, _ = p
+    zi = _inv(Z)
+    x, y = X * zi % P, Y * zi % P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def _decompress(s: bytes):
+    """RFC 8032 §5.1.3 point decoding. Returns extended point or None."""
+    if len(s) != 32:
+        return None
+    n = int.from_bytes(s, "little")
+    sign = n >> 255
+    y = n & ((1 << 255) - 1)
+    if y >= P:
+        return None
+    y2 = y * y % P
+    u = (y2 - 1) % P
+    v = (D * y2 + 1) % P
+    # candidate root x = (u/v)^((p+3)/8) computed as u v^3 (u v^7)^((p-5)/8)
+    x = u * pow(v, 3, P) * pow(u * pow(v, 7, P) % P, (P - 5) // 8, P) % P
+    vx2 = v * x * x % P
+    if vx2 == u % P:
+        pass
+    elif vx2 == (P - u) % P:
+        x = x * SQRT_M1 % P
+    else:
+        return None
+    if x == 0 and sign:
+        return None
+    if x & 1 != sign:
+        x = P - x
+    return (x, y, 1, x * y % P)
+
+
+# Base point
+_By = 4 * _inv(5) % P
+_Bx = 0
+# recover Bx from By with even sign
+_B = _decompress(_By.to_bytes(32, "little"))
+assert _B is not None
+BASE = _B
+
+
+def _clamp(h: bytes) -> int:
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a
+
+
+def pubkey_from_seed(seed: bytes) -> bytes:
+    if len(seed) != SEED_SIZE:
+        raise ValueError("ed25519 seed must be 32 bytes")
+    h = hashlib.sha512(seed).digest()
+    a = _clamp(h)
+    return _compress(_scalarmult(a, BASE))
+
+
+def sign(priv: bytes, msg: bytes) -> bytes:
+    """RFC 8032 Ed25519 signature; priv is the 64-byte (seed||pub) key."""
+    if len(priv) != PRIVKEY_SIZE:
+        raise ValueError("ed25519 private key must be 64 bytes")
+    seed, pub = priv[:32], priv[32:]
+    h = hashlib.sha512(seed).digest()
+    a = _clamp(h)
+    prefix = h[32:]
+    r = int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little") % L
+    R = _compress(_scalarmult(r, BASE))
+    k = int.from_bytes(hashlib.sha512(R + pub + msg).digest(), "little") % L
+    s = (r + k * a) % L
+    return R + s.to_bytes(32, "little")
+
+
+def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Scalar verification, bit-exact with Go crypto/ed25519 semantics."""
+    if len(pub) != PUBKEY_SIZE or len(sig) != SIGNATURE_SIZE:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return False
+    A = _decompress(pub)
+    if A is None:
+        return False
+    h = int.from_bytes(hashlib.sha512(sig[:32] + pub + msg).digest(), "little") % L
+    # R' = [s]B + [h](-A); negate A by negating X and T.
+    negA = (P - A[0], A[1], A[2], (P - A[3]) % P)
+    Rp = _add(_scalarmult(s, BASE), _scalarmult(h, negA))
+    return _compress(Rp) == sig[:32]
+
+
+def generate_seed() -> bytes:
+    return os.urandom(SEED_SIZE)
+
+
+# --- key object layer (reference: crypto/crypto.go:22-42) -------------------
+
+
+@dataclass(frozen=True)
+class PubKey(_keys.PubKey):
+    data: bytes
+
+    def __post_init__(self):
+        if len(self.data) != PUBKEY_SIZE:
+            raise ValueError("invalid ed25519 public key size")
+
+    @property
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def address(self) -> bytes:
+        from tendermint_tpu.crypto import tmhash
+
+        return tmhash.sum_truncated(self.data)
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        return verify(self.data, msg, sig)
+
+    def equals(self, other) -> bool:
+        return isinstance(other, PubKey) and other.data == self.data
+
+
+@dataclass(frozen=True)
+class PrivKey(_keys.PrivKey):
+    data: bytes
+
+    def __post_init__(self):
+        if len(self.data) != PRIVKEY_SIZE:
+            raise ValueError("invalid ed25519 private key size")
+
+    @property
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def sign(self, msg: bytes) -> bytes:
+        return sign(self.data, msg)
+
+    def pub_key(self) -> PubKey:
+        return PubKey(self.data[32:])
+
+    def equals(self, other) -> bool:
+        return isinstance(other, PrivKey) and other.data == self.data
+
+
+def gen_priv_key(seed: bytes | None = None) -> PrivKey:
+    seed = seed if seed is not None else generate_seed()
+    return PrivKey(seed + pubkey_from_seed(seed))

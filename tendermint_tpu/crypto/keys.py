@@ -1,0 +1,99 @@
+"""Key interfaces + registry (reference: crypto/crypto.go:22-42,
+crypto/encoding/codec.go).
+
+`PubKey`/`PrivKey` are the pluggable key abstractions; concrete types register
+themselves by type name ("ed25519", "sr25519", "secp256k1") so wire decoding
+and genesis parsing can round-trip any supported key.
+"""
+
+from __future__ import annotations
+
+import abc
+
+ADDRESS_SIZE = 20
+
+
+class PubKey(abc.ABC):
+    @property
+    @abc.abstractmethod
+    def type(self) -> str: ...
+
+    @abc.abstractmethod
+    def address(self) -> bytes: ...
+
+    @abc.abstractmethod
+    def bytes(self) -> bytes: ...
+
+    @abc.abstractmethod
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool: ...
+
+    @abc.abstractmethod
+    def equals(self, other) -> bool: ...
+
+    def __eq__(self, other):
+        return isinstance(other, PubKey) and self.equals(other)
+
+    def __hash__(self):
+        return hash((self.type, self.bytes()))
+
+
+class PrivKey(abc.ABC):
+    @property
+    @abc.abstractmethod
+    def type(self) -> str: ...
+
+    @abc.abstractmethod
+    def bytes(self) -> bytes: ...
+
+    @abc.abstractmethod
+    def sign(self, msg: bytes) -> bytes: ...
+
+    @abc.abstractmethod
+    def pub_key(self) -> PubKey: ...
+
+    @abc.abstractmethod
+    def equals(self, other) -> bool: ...
+
+
+_PUBKEY_TYPES: dict[str, type] = {}
+_PRIVKEY_TYPES: dict[str, type] = {}
+
+
+def register(name: str, pub_cls: type, priv_cls: type) -> None:
+    _PUBKEY_TYPES[name] = pub_cls
+    _PRIVKEY_TYPES[name] = priv_cls
+
+
+def pubkey_from_type_bytes(name: str, data: bytes) -> PubKey:
+    _ensure_registered()
+    try:
+        return _PUBKEY_TYPES[name](data)
+    except KeyError:
+        raise ValueError(f"unknown pubkey type {name!r}") from None
+
+
+def privkey_from_type_bytes(name: str, data: bytes) -> PrivKey:
+    _ensure_registered()
+    try:
+        return _PRIVKEY_TYPES[name](data)
+    except KeyError:
+        raise ValueError(f"unknown privkey type {name!r}") from None
+
+
+def _ensure_registered() -> None:
+    if not _PUBKEY_TYPES:
+        from tendermint_tpu.crypto import ed25519  # noqa: F401
+
+        register(ed25519.KEY_TYPE, ed25519.PubKey, ed25519.PrivKey)
+        try:
+            from tendermint_tpu.crypto import sr25519  # noqa: F401
+
+            register(sr25519.KEY_TYPE, sr25519.PubKey, sr25519.PrivKey)
+        except ImportError:
+            pass
+        try:
+            from tendermint_tpu.crypto import secp256k1  # noqa: F401
+
+            register(secp256k1.KEY_TYPE, secp256k1.PubKey, secp256k1.PrivKey)
+        except ImportError:
+            pass

@@ -1,0 +1,17 @@
+"""tmhash = SHA-256, with 20-byte truncated addresses
+(reference: crypto/tmhash/hash.go)."""
+
+from __future__ import annotations
+
+import hashlib
+
+SIZE = 32
+TRUNCATED_SIZE = 20
+
+
+def sum(b: bytes) -> bytes:  # noqa: A001 - mirrors reference naming
+    return hashlib.sha256(b).digest()
+
+
+def sum_truncated(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()[:TRUNCATED_SIZE]

@@ -1,0 +1,71 @@
+"""RFC 6962 merkle vectors (reference: crypto/merkle/rfc6962_test.go,
+crypto/merkle/tree_test.go)."""
+
+import hashlib
+
+from tendermint_tpu.crypto import merkle
+
+
+def test_empty_hash():
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+    assert (
+        merkle.empty_hash().hex()
+        == "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"
+    )
+
+
+def test_rfc6962_leaf_hash():
+    # RFC 6962 test: leaf hash of empty leaf = SHA-256(0x00)
+    assert (
+        merkle.leaf_hash(b"").hex()
+        == "6e340b9cffb37a989ca544e6bb780a2c78901d3fb33738768511a30617afa01d"
+    )
+    # leaf "L123456"
+    assert (
+        merkle.leaf_hash(b"L123456").hex()
+        == "395aa064aa4c29f7010acfe3f25db9485bbd4b91897b6ad7ad547639252b4d56"
+    )
+
+
+def test_rfc6962_inner_hash():
+    assert (
+        merkle.inner_hash(b"N123", b"N456").hex()
+        == "aa217fe888e47007fa15edab33c2b492a722cb106c64667fc2b044444de66bbb"
+    )
+
+
+def test_split_point():
+    assert merkle.split_point(2) == 1
+    assert merkle.split_point(3) == 2
+    assert merkle.split_point(4) == 2
+    assert merkle.split_point(5) == 4
+    assert merkle.split_point(8) == 4
+    assert merkle.split_point(9) == 8
+
+
+def test_tree_structure():
+    items = [bytes([i]) * 3 for i in range(5)]
+    # 5 leaves: split 4|1
+    left = merkle.hash_from_byte_slices(items[:4])
+    right = merkle.hash_from_byte_slices(items[4:])
+    assert merkle.hash_from_byte_slices(items) == merkle.inner_hash(left, right)
+
+
+def test_proofs_roundtrip():
+    for n in [1, 2, 3, 5, 8, 13]:
+        items = [b"item%d" % i for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        for i, proof in enumerate(proofs):
+            proof.verify(root, items[i])
+            assert proof.total == n and proof.index == i
+
+
+def test_proof_rejects_wrong_leaf():
+    items = [b"a", b"b", b"c"]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    try:
+        proofs[0].verify(root, b"x")
+        assert False, "expected failure"
+    except ValueError:
+        pass
