@@ -1,0 +1,82 @@
+"""North-star benchmark: 10k-validator commit verification (20k ed25519 sigs).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+value = p50 wall-clock milliseconds to decide 20,480 ed25519 signatures
+(batched TPU kernel, end-to-end including host preparation, steady-state:
+validator pubkey decompression cache warm - validator sets persist across
+heights, so steady-state is the operating regime).
+
+vs_baseline = speedup vs the reference's serial CPU anchor for the same batch
+(Go x/crypto ed25519 ~ 70-100us/sig/core => 85us * N; BASELINE.md crypto row).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import time
+
+N_SIGS = int(os.environ.get("BENCH_N_SIGS", 20480))
+ITERS = int(os.environ.get("BENCH_ITERS", 5))
+BASELINE_US_PER_SIG = 85.0
+
+
+def main() -> None:
+    from tendermint_tpu.crypto import ed25519 as ref
+    from tendermint_tpu.ops import ed25519_batch
+
+    # Synthetic commit: unique validators, canonical-vote-sized messages.
+    n_vals = N_SIGS // 2
+    t0 = time.monotonic()
+    items = []
+    privs = []
+    for i in range(n_vals):
+        seed = i.to_bytes(4, "big") * 8
+        privs.append(ref.gen_priv_key(seed))
+    for r in range(2):
+        for i in range(n_vals):
+            msg = (
+                b"\x08\x02\x11" + (12345).to_bytes(8, "little")
+                + b"\x19" + r.to_bytes(8, "little")
+                + b"\x22\x48" + bytes(72) + b"bench-chain"
+                + i.to_bytes(4, "big")
+            )
+            items.append((privs[i].pub_key().data, msg, ref.sign(privs[i].data, msg)))
+    gen_s = time.monotonic() - t0
+
+    # Warmup: compiles the kernel and warms the pubkey decompression cache.
+    t0 = time.monotonic()
+    out = ed25519_batch.verify_batch(items)
+    warm_s = time.monotonic() - t0
+    assert out.all(), "benchmark signatures must all verify"
+
+    times = []
+    for _ in range(ITERS):
+        t0 = time.monotonic()
+        out = ed25519_batch.verify_batch(items)
+        times.append(time.monotonic() - t0)
+    assert out.all()
+
+    p50_ms = statistics.median(times) * 1000.0
+    baseline_ms = BASELINE_US_PER_SIG * len(items) / 1000.0
+    result = {
+        "metric": "ed25519_commit_verify_%d_sigs_p50" % len(items),
+        "value": round(p50_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(baseline_ms / p50_ms, 2),
+    }
+    print(json.dumps(result))
+    # Diagnostics on stderr-like side channel: keep stdout to the ONE line.
+    import sys
+
+    print(
+        f"# gen={gen_s:.1f}s warmup={warm_s:.1f}s iters={['%.1f' % (t*1e3) for t in times]}ms"
+        f" baseline={baseline_ms:.0f}ms",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

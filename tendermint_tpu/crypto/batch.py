@@ -1,0 +1,136 @@
+"""BatchVerifier: the pluggable batch signature-verification registry.
+
+THE capability the reference lacks entirely (SURVEY.md: v0.34 has no
+BatchVerifier interface; every verify path is a serial loop over
+crypto.PubKey.VerifySignature, reference crypto/crypto.go:22-28). This module
+introduces it: callers accumulate (pubkey, msg, sig) triples and flush them in
+one call, which on TPU becomes a single wide Edwards-curve kernel launch
+(tendermint_tpu.ops.ed25519_batch).
+
+Semantics contract: `verify()` returns a per-item bitmap whose entries are
+byte-identical to what the scalar `pub_key.verify_signature` path returns for
+the same item. Callers that need the reference's serial early-exit/error-
+attribution behavior (e.g. ValidatorSet.VerifyCommitLight) replay the serial
+decision procedure over the bitmap -- verification is batched, the consensus
+semantics are not changed.
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+
+from tendermint_tpu.crypto import keys
+
+
+class BatchVerifier(abc.ABC):
+    @abc.abstractmethod
+    def add(self, pub_key: keys.PubKey, msg: bytes, sig: bytes) -> None:
+        """Queue one (pubkey, message, signature) item."""
+
+    @abc.abstractmethod
+    def verify(self) -> tuple[bool, list[bool]]:
+        """Verify everything queued. Returns (all_ok, per-item bitmap) and
+        resets the queue."""
+
+    @abc.abstractmethod
+    def __len__(self) -> int: ...
+
+
+class ScalarBatchVerifier(BatchVerifier):
+    """Fallback: the reference's serial loop, for key types without a batch
+    kernel (and for differential testing)."""
+
+    def __init__(self) -> None:
+        self._items: list[tuple[keys.PubKey, bytes, bytes]] = []
+
+    def add(self, pub_key: keys.PubKey, msg: bytes, sig: bytes) -> None:
+        self._items.append((pub_key, msg, sig))
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        out = [pk.verify_signature(m, s) for (pk, m, s) in self._items]
+        self._items = []
+        return all(out), out
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+class Ed25519BatchVerifier(BatchVerifier):
+    """TPU-batched ed25519 (tendermint_tpu.ops.ed25519_batch)."""
+
+    def __init__(self) -> None:
+        self._items: list[tuple[bytes, bytes, bytes]] = []
+
+    def add(self, pub_key: keys.PubKey, msg: bytes, sig: bytes) -> None:
+        self._items.append((pub_key.bytes(), msg, sig))
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        from tendermint_tpu.ops import ed25519_batch
+
+        bitmap = ed25519_batch.verify_batch(self._items)
+        self._items = []
+        out = [bool(b) for b in bitmap]
+        return all(out), out
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+class MixedBatchVerifier(BatchVerifier):
+    """Routes items to a per-key-type verifier, preserving item order in the
+    result bitmap. Lets commits with mixed ed25519/sr25519/secp256k1 validator
+    sets still batch the ed25519 majority."""
+
+    def __init__(self) -> None:
+        self._order: list[tuple[str, int]] = []
+        self._subs: dict[str, BatchVerifier] = {}
+
+    def add(self, pub_key: keys.PubKey, msg: bytes, sig: bytes) -> None:
+        kt = pub_key.type
+        sub = self._subs.get(kt)
+        if sub is None:
+            sub = create_batch_verifier(kt)
+            self._subs[kt] = sub
+        self._order.append((kt, len(sub)))
+        sub.add(pub_key, msg, sig)
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        results = {kt: sub.verify()[1] for kt, sub in self._subs.items()}
+        out = [results[kt][i] for (kt, i) in self._order]
+        self._order = []
+        self._subs = {}
+        return all(out), out
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+
+_BATCH_TYPES: dict[str, type] = {}
+
+
+def register_batch_verifier(key_type: str, cls: type) -> None:
+    _BATCH_TYPES[key_type] = cls
+
+
+def supports_batch(key_type: str) -> bool:
+    _ensure()
+    return key_type in _BATCH_TYPES
+
+
+def create_batch_verifier(key_type: str | None = None) -> BatchVerifier:
+    """Batch verifier for one key type, or a mixed router when None."""
+    _ensure()
+    if key_type is None:
+        return MixedBatchVerifier()
+    cls = _BATCH_TYPES.get(key_type, ScalarBatchVerifier)
+    return cls()
+
+
+def _ensure() -> None:
+    if _BATCH_TYPES:
+        return
+    if os.environ.get("TM_TPU_DISABLE_BATCH") == "1":
+        _BATCH_TYPES["_disabled"] = ScalarBatchVerifier
+        return
+    _BATCH_TYPES["ed25519"] = Ed25519BatchVerifier

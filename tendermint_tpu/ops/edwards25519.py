@@ -1,0 +1,92 @@
+"""Batched twisted-Edwards point arithmetic on device.
+
+Points are (X, Y, Z, T) extended homogeneous coordinates stored as a single
+int32 array of shape (..., 4, 20) (limb layout per field25519). The addition
+law is the unified a=-1 twisted Edwards formula ("add-2008-hwcd-3"), which is
+COMPLETE for all points of curve25519 (a = -1 is square mod p, d non-square),
+so identity / small-order inputs need no special-casing - crucial on TPU where
+data-dependent branches are unavailable.
+
+Bounds: every mul input below is the output of add/sub/mul/mul_small, all of
+which return limbs <= 9409 < 9500 = the NORM bound field25519.mul requires.
+
+Mirrors the scalar reference tendermint_tpu/crypto/ed25519.py:_add/_double
+(semantics of Go crypto/ed25519 internals; reference crypto/ed25519/ed25519.go).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from tendermint_tpu.ops import field25519 as fe
+
+P = fe.P
+D = (-121665 * pow(121666, P - 2, P)) % P
+TWO_D_LIMBS = fe.from_int(2 * D % P)
+
+# identity (0, 1, 1, 0)
+IDENTITY_LIMBS = np.stack(
+    [fe.from_int(0), fe.from_int(1), fe.from_int(1), fe.from_int(0)]
+)  # (4, 20)
+
+
+def identity(shape=()) -> jnp.ndarray:
+    return jnp.broadcast_to(
+        jnp.asarray(IDENTITY_LIMBS), tuple(shape) + (4, 20)
+    ).astype(jnp.int32)
+
+
+def from_affine(x: int, y: int) -> np.ndarray:
+    """Host-side: affine ints -> extended limb point (4, 20)."""
+    return np.stack(
+        [fe.from_int(x), fe.from_int(y), fe.from_int(1), fe.from_int(x * y % P)]
+    )
+
+
+def negate_affine(x: int, y: int) -> np.ndarray:
+    return from_affine((-x) % P, y)
+
+
+def add(p, q):
+    """Unified extended addition. p, q: (..., 4, 20) -> (..., 4, 20)."""
+    X1, Y1, Z1, T1 = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
+    X2, Y2, Z2, T2 = q[..., 0, :], q[..., 1, :], q[..., 2, :], q[..., 3, :]
+    a = fe.mul(fe.sub(Y1, X1), fe.sub(Y2, X2))
+    b = fe.mul(fe.add(Y1, X1), fe.add(Y2, X2))
+    c = fe.mul(fe.mul(T1, T2), jnp.asarray(TWO_D_LIMBS))
+    d = fe.mul_small(fe.mul(Z1, Z2), 2)
+    e = fe.sub(b, a)
+    f = fe.sub(d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
+    return jnp.stack(
+        [fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h)], axis=-2
+    )
+
+
+def double(p):
+    """Dedicated doubling (dbl-2008-hwcd)."""
+    X1, Y1, Z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    a = fe.square(X1)
+    b = fe.square(Y1)
+    c = fe.mul_small(fe.square(Z1), 2)
+    h = fe.add(a, b)
+    e = fe.sub(h, fe.square(fe.add(X1, Y1)))
+    g = fe.sub(a, b)
+    f = fe.add(c, g)
+    return jnp.stack(
+        [fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h)], axis=-2
+    )
+
+
+def compress_canonical(p):
+    """(..., 4, 20) -> (y_limbs canonical (..., 20), sign (...,) int32).
+
+    The canonical RFC 8032 encoding is y (fully reduced < p, little-endian)
+    with the parity of x in the top bit; returned here in limb+sign form for
+    direct comparison against a signature's R bytes."""
+    zinv = fe.inv(p[..., 2, :])
+    x = fe.to_canonical(fe.mul(p[..., 0, :], zinv))
+    y = fe.to_canonical(fe.mul(p[..., 1, :], zinv))
+    return y, x[..., 0] & 1

@@ -1,0 +1,186 @@
+"""GF(2^255-19) arithmetic in TPU-friendly limb form.
+
+Field elements are int32 arrays of shape (..., 20): radix-2^13 limbs,
+value = sum(limb[i] * 2**(13*i)), 260 bits of headroom over the 255-bit field.
+
+Why radix 2^13 / int32: TPUs have no native int64; the VPU's fast integer path
+is int32. Products of 13-bit limbs are < 2^26, and the 20-term convolution plus
+the 2^260 === 608 (mod p) fold stays below 2^31 (bounds below), so the whole
+multiplier runs in exact int32 arithmetic with zero wide-word emulation.
+
+Bound discipline (the invariant every stored element satisfies):
+
+    NORM: all limbs in [0, 9500)        ("loosely normalized")
+
+* mul(a, b) requires NORM inputs, returns limbs <= 8799.
+* add(a, b) requires NORM inputs, returns limbs <= 9409.
+* sub(a, b) requires NORM inputs, returns limbs <= 8799.
+
+Bound proof for mul with M = 9500: products <= M^2 = 9.03e7; low-convolution
+c_k sums <= 20 terms -> 1.81e9; the high half d_k (<= 19 M^2) is split
+d = hi*2^13 + lo then folded as 608*lo (<= 5.0e6) and 608*hi (<= 1.27e8);
+c_k + 608*lo_k + 608*hi_{k-1} <= 1.94e9 < 2^31 - 1. Values are only fully
+reduced mod p at encode/compare time (to_canonical).
+
+Ops are written to keep the traced HLO graph small (vectorized limb axes,
+sequential only where carries force it), since a full verify chains ~3-4k
+field muls.
+
+Reference semantics served: the scalar path tendermint_tpu/crypto/ed25519.py
+(itself mirroring Go crypto/ed25519; reference crypto/ed25519/ed25519.go:148).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+P = 2**255 - 19
+NLIMB = 20
+RADIX = 13
+MASK = (1 << RADIX) - 1  # 8191
+# 2^260 = 2^5 * 2^255 === 32*19 = 608 (mod p): fold factor for limbs 20+.
+FOLD = 608
+
+_P_CANON = [(P >> (RADIX * i)) & MASK for i in range(NLIMB)]
+# 32*p with every limb scaled by 32: limb-wise a + 32P - b never goes negative
+# for NORM b (min fat limb = 32*511 = 16352 > 9500).
+P32_LIMBS = np.array([32 * l for l in _P_CANON], dtype=np.int32)
+P_LIMBS = np.array(_P_CANON, dtype=np.int32)
+
+
+def from_int(x: int) -> np.ndarray:
+    """Python int -> canonical limb vector (numpy int32, shape (20,))."""
+    x %= P
+    return np.array([(x >> (RADIX * i)) & MASK for i in range(NLIMB)], dtype=np.int32)
+
+
+def to_int(limbs) -> int:
+    """Limb vector (shape (20,)) -> Python int (not reduced mod p)."""
+    arr = np.asarray(limbs)
+    return sum(int(arr[i]) << (RADIX * i) for i in range(NLIMB))
+
+
+def zeros(shape=()) -> jnp.ndarray:
+    return jnp.zeros(tuple(shape) + (NLIMB,), dtype=jnp.int32)
+
+
+def _carry_once(e):
+    """One sequential carry pass + top fold. Accepts limbs < 2^31 - 2^27,
+    returns limbs <= max(9409, 8191 + input_carry_spill) -- see callers."""
+    out = []
+    carry = jnp.zeros_like(e[..., 0])
+    for k in range(NLIMB):
+        v = e[..., k] + carry
+        carry = v >> RADIX
+        out.append(v & MASK)
+    # carry = overflow past limb 19 (weight 2^260): fold by 608.
+    o0 = out[0] + carry * FOLD
+    c0 = o0 >> RADIX
+    out[0] = o0 & MASK
+    out[1] = out[1] + c0
+    return jnp.stack(out, axis=-1)
+
+
+def carry(e):
+    """Full renormalization to NORM (limbs <= 8799): two passes."""
+    return _carry_once(_carry_once(e))
+
+
+def add(a, b):
+    """a + b. NORM in -> limbs <= 9409 out."""
+    return _carry_once(a + b)
+
+
+def sub(a, b):
+    """a - b mod p via a + 32p - b with fat limbs (never negative).
+    Max pre-carry limb ~ 2^18.1; one pass leaves limb0 <= 8191 + 33*608 over
+    -> needs the extra limb-0 step inside _carry_once; result <= 9409."""
+    m = jnp.asarray(P32_LIMBS)
+    return _carry_once(a + m - b)
+
+
+def mul(a, b):
+    """Limb-convolution multiply + fold. NORM in, limbs <= 8799 out.
+
+    Vectorized shift-accumulate keeps this at ~100 HLO ops instead of the
+    naive 400 scalar products."""
+    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    conv = jnp.zeros(shape + (2 * NLIMB - 1,), dtype=jnp.int32)
+    for i in range(NLIMB):
+        conv = conv.at[..., i : i + NLIMB].add(a[..., i : i + 1] * b)
+    c = conv[..., :NLIMB]
+    d = conv[..., NLIMB:]  # 19 entries, weights 2^260.. -> fold by 608
+    lo = d & MASK
+    hi = d >> RADIX
+    e = c.at[..., : NLIMB - 1].add(FOLD * lo)
+    e = e.at[..., 1:NLIMB].add(FOLD * hi)
+    return carry(e)
+
+
+def mul_small(a, c: int):
+    """a * c for a small positive Python int (c <= ~220000 keeps 9500*c < 2^31)."""
+    return _carry_once(a * jnp.int32(c))
+
+
+def square(a):
+    return mul(a, a)
+
+
+def nsquare(a, n: int):
+    """a^(2^n) with a rolled loop to keep the graph small."""
+    if n <= 2:
+        for _ in range(n):
+            a = square(a)
+        return a
+    return jax.lax.fori_loop(0, n, lambda _, x: square(x), a)
+
+
+def inv(a):
+    """a^(p-2): standard curve25519 addition chain (11 muls, 254 squarings)."""
+    z2 = square(a)
+    z9 = mul(a, nsquare(z2, 2))
+    z11 = mul(z2, z9)
+    z_5_0 = mul(z9, square(z11))          # 2^5 - 2^0
+    z_10_0 = mul(nsquare(z_5_0, 5), z_5_0)
+    z_20_0 = mul(nsquare(z_10_0, 10), z_10_0)
+    z_40_0 = mul(nsquare(z_20_0, 20), z_20_0)
+    z_50_0 = mul(nsquare(z_40_0, 10), z_10_0)
+    z_100_0 = mul(nsquare(z_50_0, 50), z_50_0)
+    z_200_0 = mul(nsquare(z_100_0, 100), z_100_0)
+    z_250_0 = mul(nsquare(z_200_0, 50), z_50_0)
+    return mul(nsquare(z_250_0, 5), z11)  # 2^255 - 21
+
+
+def to_canonical(a):
+    """Fully reduce NORM limbs to the canonical representative < p.
+
+    NORM value < 2^260 ~= 32p. Fold bits >= 255 by 19 (twice, for re-carry),
+    then up to two conditional subtractions of p."""
+    for _ in range(2):
+        top = a[..., NLIMB - 1]
+        a = a.at[..., NLIMB - 1].set(top & 0xFF)
+        a = a.at[..., 0].add((top >> 8) * 19)
+        a = _carry_once(a)
+    p_limbs = jnp.asarray(P_LIMBS)
+    for _ in range(2):
+        diff = []
+        borrow = jnp.zeros_like(a[..., 0])
+        for k in range(NLIMB):
+            v = a[..., k] - p_limbs[k] - borrow
+            borrow = (v < 0).astype(jnp.int32)
+            diff.append(v + borrow * (MASK + 1))
+        diff = jnp.stack(diff, axis=-1)
+        a = jnp.where((borrow == 0)[..., None], diff, a)
+    return a
+
+
+def eq(a, b):
+    """Element-wise field equality of canonical representations."""
+    return jnp.all(a == b, axis=-1)
+
+
+def select(cond, a, b):
+    """where(cond, a, b) broadcasting cond over the limb axis."""
+    return jnp.where(cond[..., None], a, b)

@@ -1,0 +1,76 @@
+"""BlockID and PartSetHeader (reference: types/block.go:1112-1180,
+proto/tendermint/types/types.proto BlockID/PartSetHeader)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto import tmhash
+from tendermint_tpu.encoding import proto
+
+
+@dataclass(frozen=True)
+class PartSetHeader:
+    total: int = 0
+    hash: bytes = b""
+
+    def is_zero(self) -> bool:
+        return self.total == 0 and len(self.hash) == 0
+
+    def validate_basic(self) -> None:
+        if self.hash and len(self.hash) != tmhash.SIZE:
+            raise ValueError("wrong PartSetHeader hash size")
+
+    def marshal(self) -> bytes:
+        return proto.Writer().uvarint(1, self.total).bytes(2, self.hash).out()
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "PartSetHeader":
+        f = proto.fields(buf)
+        return PartSetHeader(
+            total=f.get(1, [0])[-1], hash=f.get(2, [b""])[-1]
+        )
+
+
+@dataclass(frozen=True)
+class BlockID:
+    hash: bytes = b""
+    part_set_header: PartSetHeader = field(default_factory=PartSetHeader)
+
+    def is_zero(self) -> bool:
+        """Nil-block marker (a vote for nil)."""
+        return len(self.hash) == 0 and self.part_set_header.is_zero()
+
+    def is_complete(self) -> bool:
+        """Points to a real block."""
+        return (
+            len(self.hash) == tmhash.SIZE
+            and self.part_set_header.total > 0
+            and len(self.part_set_header.hash) == tmhash.SIZE
+        )
+
+    def validate_basic(self) -> None:
+        if self.hash and len(self.hash) != tmhash.SIZE:
+            raise ValueError("wrong BlockID hash size")
+        self.part_set_header.validate_basic()
+
+    def key(self) -> bytes:
+        """Map key (reference: types/block.go BlockID.Key)."""
+        return self.hash + self.part_set_header.marshal()
+
+    def marshal(self) -> bytes:
+        return (
+            proto.Writer()
+            .bytes(1, self.hash)
+            .message(2, self.part_set_header.marshal(), always=True)
+            .out()
+        )
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "BlockID":
+        f = proto.fields(buf)
+        psh = PartSetHeader.unmarshal(f.get(2, [b""])[-1])
+        return BlockID(hash=f.get(1, [b""])[-1], part_set_header=psh)
+
+    def __str__(self) -> str:
+        return f"{self.hash.hex()[:12]}:{self.part_set_header.total}"

@@ -1,0 +1,169 @@
+"""Evidence types (reference: types/evidence.go:22-320,
+proto/tendermint/types/evidence.proto).
+
+DuplicateVoteEvidence: a validator signed two conflicting votes at the same
+H/R/T. LightClientAttackEvidence: a conflicting light block with common
+ancestor, listing byzantine validators.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto import tmhash
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.vote import Vote
+
+
+class EvidenceError(Exception):
+    pass
+
+
+@dataclass
+class DuplicateVoteEvidence:
+    vote_a: Vote
+    vote_b: Vote
+    total_voting_power: int = 0
+    validator_power: int = 0
+    timestamp: Time = field(default_factory=Time.zero)
+
+    @staticmethod
+    def new(vote1: Vote, vote2: Vote, block_time: Time, val_set) -> "DuplicateVoteEvidence | None":
+        """Orders votes by BlockID key (reference: types/evidence.go:49-74)."""
+        if vote1 is None or vote2 is None or val_set is None:
+            return None
+        idx, val = val_set.get_by_address(vote1.validator_address)
+        if idx == -1:
+            return None
+        if vote1.block_id.key() < vote2.block_id.key():
+            vote_a, vote_b = vote1, vote2
+        else:
+            vote_a, vote_b = vote2, vote1
+        return DuplicateVoteEvidence(
+            vote_a=vote_a,
+            vote_b=vote_b,
+            total_voting_power=val_set.total_voting_power(),
+            validator_power=val.voting_power,
+            timestamp=block_time,
+        )
+
+    def _inner(self) -> bytes:
+        return (
+            proto.Writer()
+            .message(1, self.vote_a.marshal())
+            .message(2, self.vote_b.marshal())
+            .varint(3, self.total_voting_power)
+            .varint(4, self.validator_power)
+            .message(5, self.timestamp.marshal(), always=True)
+            .out()
+        )
+
+    def bytes(self) -> bytes:
+        """Evidence-oneof wrapper marshal (reference: types/evidence.go:90)."""
+        return proto.Writer().message(1, self._inner(), always=True).out()
+
+    def hash(self) -> bytes:
+        return tmhash.sum(self.bytes())
+
+    def height(self) -> int:
+        return self.vote_a.height
+
+    def time(self) -> Time:
+        return self.timestamp
+
+    def validate_basic(self) -> None:
+        if self.vote_a is None or self.vote_b is None:
+            raise EvidenceError("empty duplicate vote evidence")
+        if not self.vote_a.signature or not self.vote_b.signature:
+            raise EvidenceError("empty signature")
+        if self.vote_a.block_id.key() >= self.vote_b.block_id.key():
+            raise EvidenceError("duplicate votes in invalid order (or the same block id)")
+
+    def __str__(self) -> str:
+        return (
+            f"DuplicateVoteEvidence{{VoteA: {self.vote_a}, VoteB: {self.vote_b}}}"
+        )
+
+    @staticmethod
+    def unmarshal_inner(buf: bytes) -> "DuplicateVoteEvidence":
+        f = proto.fields(buf)
+        return DuplicateVoteEvidence(
+            vote_a=Vote.unmarshal(f.get(1, [b""])[-1]),
+            vote_b=Vote.unmarshal(f.get(2, [b""])[-1]),
+            total_voting_power=proto.as_sint64(f.get(3, [0])[-1]),
+            validator_power=proto.as_sint64(f.get(4, [0])[-1]),
+            timestamp=Time.unmarshal(f.get(5, [b""])[-1]),
+        )
+
+
+@dataclass
+class LightClientAttackEvidence:
+    conflicting_block: object  # light.LightBlock (SignedHeader + ValidatorSet)
+    common_height: int
+    byzantine_validators: list = field(default_factory=list)
+    total_voting_power: int = 0
+    timestamp: Time = field(default_factory=Time.zero)
+
+    def _inner(self) -> bytes:
+        w = proto.Writer()
+        if self.conflicting_block is not None:
+            w.message(1, self.conflicting_block.marshal())
+        w.varint(2, self.common_height)
+        for v in self.byzantine_validators:
+            w.message(3, v.marshal())
+        w.varint(4, self.total_voting_power)
+        w.message(5, self.timestamp.marshal(), always=True)
+        return w.out()
+
+    def bytes(self) -> bytes:
+        return proto.Writer().message(2, self._inner(), always=True).out()
+
+    def hash(self) -> bytes:
+        return tmhash.sum(self.bytes())
+
+    def height(self) -> int:
+        return self.common_height
+
+    def time(self) -> Time:
+        return self.timestamp
+
+    def validate_basic(self) -> None:
+        if self.conflicting_block is None:
+            raise EvidenceError("conflicting block is nil")
+        if self.common_height <= 0:
+            raise EvidenceError("negative or zero common height")
+
+    def __str__(self) -> str:
+        return (
+            f"LightClientAttackEvidence{{CommonHeight: {self.common_height}, "
+            f"Byzantine: {len(self.byzantine_validators)}}}"
+        )
+
+    @staticmethod
+    def unmarshal_inner(buf: bytes) -> "LightClientAttackEvidence":
+        from tendermint_tpu.types.validator import Validator
+
+        f = proto.fields(buf)
+        cb = None
+        if 1 in f:
+            from tendermint_tpu.types.light_block import LightBlock
+
+            cb = LightBlock.unmarshal(f[1][-1])
+        return LightClientAttackEvidence(
+            conflicting_block=cb,
+            common_height=proto.as_sint64(f.get(2, [0])[-1]),
+            byzantine_validators=[Validator.unmarshal(b) for b in f.get(3, [])],
+            total_voting_power=proto.as_sint64(f.get(4, [0])[-1]),
+            timestamp=Time.unmarshal(f.get(5, [b""])[-1]),
+        )
+
+
+def evidence_unmarshal(buf: bytes):
+    """Evidence oneof decode."""
+    f = proto.fields(buf)
+    if 1 in f:
+        return DuplicateVoteEvidence.unmarshal_inner(f[1][-1])
+    if 2 in f:
+        return LightClientAttackEvidence.unmarshal_inner(f[2][-1])
+    raise EvidenceError("unknown evidence type")

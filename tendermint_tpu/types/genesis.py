@@ -1,0 +1,209 @@
+"""GenesisDoc (reference: types/genesis.go)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto import keys, tmhash
+from tendermint_tpu.types.params import (
+    BlockParams,
+    ConsensusParams,
+    EvidenceParams,
+    ValidatorParams,
+    VersionParams,
+)
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.validator import Validator
+
+MAX_CHAIN_ID_LEN = 50
+
+
+@dataclass
+class GenesisValidator:
+    address: bytes
+    pub_key: keys.PubKey
+    power: int
+    name: str = ""
+
+
+@dataclass
+class GenesisDoc:
+    genesis_time: Time = field(default_factory=Time.zero)
+    chain_id: str = ""
+    initial_height: int = 1
+    consensus_params: ConsensusParams | None = None
+    validators: list[GenesisValidator] = field(default_factory=list)
+    app_hash: bytes = b""
+    app_state: bytes = b"{}"
+
+    def validate_and_complete(self) -> None:
+        """reference: types/genesis.go:60-103."""
+        if not self.chain_id:
+            raise ValueError("genesis doc must include non-empty chain_id")
+        if len(self.chain_id) > MAX_CHAIN_ID_LEN:
+            raise ValueError(f"chain_id in genesis doc is too long (max: {MAX_CHAIN_ID_LEN})")
+        if self.initial_height < 0:
+            raise ValueError("initial_height cannot be negative")
+        if self.initial_height == 0:
+            self.initial_height = 1
+        if self.consensus_params is None:
+            self.consensus_params = ConsensusParams()
+        else:
+            self.consensus_params.validate_basic()
+        for i, v in enumerate(self.validators):
+            if v.power == 0:
+                raise ValueError(f"the genesis file cannot contain validators with no voting power: {v}")
+            if v.address and v.pub_key.address() != v.address:
+                raise ValueError(f"incorrect address for validator {i} in the genesis file")
+            if not v.address:
+                v.address = v.pub_key.address()
+        if self.genesis_time.is_zero():
+            self.genesis_time = Time.now()
+
+    def validator_hash(self) -> bytes:
+        from tendermint_tpu.crypto import merkle
+
+        vals = [Validator.new(v.pub_key, v.power) for v in self.validators]
+        return merkle.hash_from_byte_slices([v.bytes() for v in vals])
+
+    # --- JSON round trip (operator-facing file format) ---------------------
+
+    def to_json(self) -> str:
+        def enc_val(v: GenesisValidator):
+            return {
+                "address": v.address.hex().upper(),
+                "pub_key": {
+                    "type": _pubkey_json_type(v.pub_key.type),
+                    "value": _b64(v.pub_key.bytes()),
+                },
+                "power": str(v.power),
+                "name": v.name,
+            }
+
+        cp = self.consensus_params or ConsensusParams()
+        doc = {
+            "genesis_time": str(self.genesis_time),
+            "chain_id": self.chain_id,
+            "initial_height": str(self.initial_height),
+            "consensus_params": {
+                "block": {
+                    "max_bytes": str(cp.block.max_bytes),
+                    "max_gas": str(cp.block.max_gas),
+                    "time_iota_ms": str(cp.block.time_iota_ms),
+                },
+                "evidence": {
+                    "max_age_num_blocks": str(cp.evidence.max_age_num_blocks),
+                    "max_age_duration": str(cp.evidence.max_age_duration_ns),
+                    "max_bytes": str(cp.evidence.max_bytes),
+                },
+                "validator": {"pub_key_types": list(cp.validator.pub_key_types)},
+                "version": {"app_version": str(cp.version.app_version)},
+            },
+            "validators": [enc_val(v) for v in self.validators],
+            "app_hash": self.app_hash.hex().upper(),
+            "app_state": json.loads(self.app_state.decode() or "{}"),
+        }
+        return json.dumps(doc, indent=2)
+
+    @staticmethod
+    def from_json(data: str) -> "GenesisDoc":
+        doc = json.loads(data)
+        vals = []
+        for v in doc.get("validators") or []:
+            pk = keys.pubkey_from_type_bytes(
+                _pubkey_type_from_json(v["pub_key"]["type"]), _unb64(v["pub_key"]["value"])
+            )
+            vals.append(
+                GenesisValidator(
+                    address=bytes.fromhex(v.get("address", "")),
+                    pub_key=pk,
+                    power=int(v["power"]),
+                    name=v.get("name", ""),
+                )
+            )
+        cp_doc = doc.get("consensus_params")
+        cp = None
+        if cp_doc:
+            cp = ConsensusParams(
+                block=BlockParams(
+                    max_bytes=int(cp_doc["block"]["max_bytes"]),
+                    max_gas=int(cp_doc["block"]["max_gas"]),
+                    time_iota_ms=int(cp_doc["block"].get("time_iota_ms", 1000)),
+                ),
+                evidence=EvidenceParams(
+                    max_age_num_blocks=int(cp_doc["evidence"]["max_age_num_blocks"]),
+                    max_age_duration_ns=int(cp_doc["evidence"]["max_age_duration"]),
+                    max_bytes=int(cp_doc["evidence"].get("max_bytes", 1048576)),
+                ),
+                validator=ValidatorParams(
+                    pub_key_types=tuple(cp_doc["validator"]["pub_key_types"])
+                ),
+                version=VersionParams(
+                    app_version=int(cp_doc.get("version", {}).get("app_version", 0))
+                ),
+            )
+        gd = GenesisDoc(
+            genesis_time=_parse_time(doc.get("genesis_time", "")),
+            chain_id=doc["chain_id"],
+            initial_height=int(doc.get("initial_height", 1)),
+            consensus_params=cp,
+            validators=vals,
+            app_hash=bytes.fromhex(doc.get("app_hash", "")),
+            app_state=json.dumps(doc.get("app_state", {})).encode(),
+        )
+        gd.validate_and_complete()
+        return gd
+
+    def save_as(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @staticmethod
+    def from_file(path: str) -> "GenesisDoc":
+        with open(path) as f:
+            return GenesisDoc.from_json(f.read())
+
+
+def _b64(b: bytes) -> str:
+    import base64
+
+    return base64.b64encode(b).decode()
+
+
+def _unb64(s: str) -> bytes:
+    import base64
+
+    return base64.b64decode(s)
+
+
+def _pubkey_json_type(t: str) -> str:
+    return {
+        "ed25519": "tendermint/PubKeyEd25519",
+        "secp256k1": "tendermint/PubKeySecp256k1",
+        "sr25519": "tendermint/PubKeySr25519",
+    }[t]
+
+
+def _pubkey_type_from_json(t: str) -> str:
+    return {
+        "tendermint/PubKeyEd25519": "ed25519",
+        "tendermint/PubKeySecp256k1": "secp256k1",
+        "tendermint/PubKeySr25519": "sr25519",
+    }[t]
+
+
+def _parse_time(s: str) -> Time:
+    if not s or s.startswith("0001-01-01"):
+        return Time.zero()
+    import calendar
+    import re
+
+    m = re.match(r"(\d+)-(\d+)-(\d+)T(\d+):(\d+):(\d+)(\.\d+)?Z?", s)
+    if not m:
+        return Time.zero()
+    secs = calendar.timegm(
+        (int(m[1]), int(m[2]), int(m[3]), int(m[4]), int(m[5]), int(m[6]), 0, 0, 0)
+    )
+    nanos = int(float(m[7] or 0) * 1e9)
+    return Time(secs, nanos)

@@ -1,0 +1,100 @@
+"""SignedHeader and LightBlock (reference: types/light_block.go,
+proto/tendermint/types/types.proto SignedHeader/LightBlock)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.types.block import Commit, Header
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+
+@dataclass
+class SignedHeader:
+    header: Header | None = None
+    commit: Commit | None = None
+
+    def validate_basic(self, chain_id: str) -> None:
+        if self.header is None:
+            raise ValueError("missing header")
+        if self.commit is None:
+            raise ValueError("missing commit")
+        self.header.validate_basic()
+        self.commit.validate_basic()
+        if self.header.chain_id != chain_id:
+            raise ValueError(
+                f"header belongs to another chain {self.header.chain_id!r}, not {chain_id!r}"
+            )
+        if self.commit.height != self.header.height:
+            raise ValueError(
+                f"header and commit height mismatch: {self.header.height} vs {self.commit.height}"
+            )
+        hhash = self.header.hash()
+        if self.commit.block_id.hash != hhash:
+            raise ValueError(
+                f"commit signs block {self.commit.block_id.hash.hex()}, header is block {hhash.hex()}"
+            )
+
+    @property
+    def height(self) -> int:
+        return self.header.height if self.header else 0
+
+    def hash(self) -> bytes | None:
+        return self.header.hash() if self.header else None
+
+    def marshal(self) -> bytes:
+        w = proto.Writer()
+        if self.header is not None:
+            w.message(1, self.header.marshal(), always=True)
+        if self.commit is not None:
+            w.message(2, self.commit.marshal(), always=True)
+        return w.out()
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "SignedHeader":
+        f = proto.fields(buf)
+        return SignedHeader(
+            header=Header.unmarshal(f[1][-1]) if 1 in f else None,
+            commit=Commit.unmarshal(f[2][-1]) if 2 in f else None,
+        )
+
+
+@dataclass
+class LightBlock:
+    signed_header: SignedHeader | None = None
+    validator_set: ValidatorSet | None = None
+
+    def validate_basic(self, chain_id: str) -> None:
+        if self.signed_header is None:
+            raise ValueError("missing signed header")
+        if self.validator_set is None:
+            raise ValueError("missing validator set")
+        self.signed_header.validate_basic(chain_id)
+        self.validator_set.validate_basic()
+        vh = self.validator_set.hash()
+        if self.signed_header.header.validators_hash != vh:
+            raise ValueError(
+                f"expected validators hash of light block to match validator set hash "
+                f"({self.signed_header.header.validators_hash.hex()} != {vh.hex()})"
+            )
+
+    @property
+    def height(self) -> int:
+        return self.signed_header.height if self.signed_header else 0
+
+    def marshal(self) -> bytes:
+        w = proto.Writer()
+        if self.signed_header is not None:
+            w.message(1, self.signed_header.marshal(), always=True)
+        if self.validator_set is not None:
+            w.message(2, self.validator_set.marshal(), always=True)
+        return w.out()
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "LightBlock":
+        f = proto.fields(buf)
+        return LightBlock(
+            signed_header=SignedHeader.unmarshal(f[1][-1]) if 1 in f else None,
+            validator_set=ValidatorSet.unmarshal(f[2][-1]) if 2 in f else None,
+        )

@@ -1,0 +1,161 @@
+"""ConsensusParams (reference: types/params.go,
+proto/tendermint/types/params.proto)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from tendermint_tpu.crypto import tmhash
+from tendermint_tpu.encoding import proto
+
+MAX_BLOCK_SIZE_BYTES = 104857600
+ABCI_PUBKEY_TYPE_ED25519 = "ed25519"
+ABCI_PUBKEY_TYPE_SECP256K1 = "secp256k1"
+ABCI_PUBKEY_TYPE_SR25519 = "sr25519"
+
+
+@dataclass(frozen=True)
+class BlockParams:
+    max_bytes: int = 22020096  # 21MB
+    max_gas: int = -1
+    time_iota_ms: int = 1000
+
+    def marshal(self) -> bytes:
+        return (
+            proto.Writer()
+            .varint(1, self.max_bytes)
+            .varint(2, self.max_gas)
+            .varint(3, self.time_iota_ms)
+            .out()
+        )
+
+
+@dataclass(frozen=True)
+class EvidenceParams:
+    max_age_num_blocks: int = 100000
+    max_age_duration_ns: int = 48 * 3600 * 1_000_000_000  # 48h
+    max_bytes: int = 1048576
+
+    def marshal(self) -> bytes:
+        dur = (
+            proto.Writer()
+            .varint(1, self.max_age_duration_ns // 1_000_000_000)
+            .varint(2, self.max_age_duration_ns % 1_000_000_000)
+            .out()
+        )
+        return (
+            proto.Writer()
+            .varint(1, self.max_age_num_blocks)
+            .message(2, dur, always=True)
+            .varint(3, self.max_bytes)
+            .out()
+        )
+
+
+@dataclass(frozen=True)
+class ValidatorParams:
+    pub_key_types: tuple = (ABCI_PUBKEY_TYPE_ED25519,)
+
+    def marshal(self) -> bytes:
+        w = proto.Writer()
+        for t in self.pub_key_types:
+            w.string(1, t)
+        return w.out()
+
+
+@dataclass(frozen=True)
+class VersionParams:
+    app_version: int = 0
+
+    def marshal(self) -> bytes:
+        return proto.Writer().uvarint(1, self.app_version).out()
+
+
+@dataclass(frozen=True)
+class ConsensusParams:
+    block: BlockParams = field(default_factory=BlockParams)
+    evidence: EvidenceParams = field(default_factory=EvidenceParams)
+    validator: ValidatorParams = field(default_factory=ValidatorParams)
+    version: VersionParams = field(default_factory=VersionParams)
+
+    def hash(self) -> bytes:
+        """SHA-256 of HashedParams{BlockMaxBytes, BlockMaxGas} (reference:
+        types/params.go:137-155)."""
+        hp = proto.Writer().varint(1, self.block.max_bytes).varint(2, self.block.max_gas).out()
+        return tmhash.sum(hp)
+
+    def validate_basic(self) -> None:
+        if self.block.max_bytes <= 0:
+            raise ValueError(f"block.MaxBytes must be greater than 0. Got {self.block.max_bytes}")
+        if self.block.max_bytes > MAX_BLOCK_SIZE_BYTES:
+            raise ValueError("block.MaxBytes is too big")
+        if self.block.max_gas < -1:
+            raise ValueError(f"block.MaxGas must be greater or equal to -1. Got {self.block.max_gas}")
+        if self.evidence.max_age_num_blocks <= 0:
+            raise ValueError("evidence.MaxAgeNumBlocks must be greater than 0")
+        if self.evidence.max_age_duration_ns <= 0:
+            raise ValueError("evidence.MaxAgeDuration must be greater than 0")
+        if self.evidence.max_bytes > self.block.max_bytes:
+            raise ValueError("evidence.MaxBytesEvidence is greater than upper bound")
+        if self.evidence.max_bytes < 0:
+            raise ValueError("evidence.MaxBytes must be non negative")
+        if not self.pub_key_types_valid():
+            raise ValueError("validator.PubKeyTypes must not be empty / unknown")
+
+    def pub_key_types_valid(self) -> bool:
+        known = {ABCI_PUBKEY_TYPE_ED25519, ABCI_PUBKEY_TYPE_SECP256K1, ABCI_PUBKEY_TYPE_SR25519}
+        return bool(self.validator.pub_key_types) and all(
+            t in known for t in self.validator.pub_key_types
+        )
+
+    def update(self, block=None, evidence=None, validator=None, version=None) -> "ConsensusParams":
+        """Apply ABCI EndBlock param updates (reference: types/params.go
+        UpdateConsensusParams)."""
+        out = self
+        if block is not None:
+            out = replace(out, block=block)
+        if evidence is not None:
+            out = replace(out, evidence=evidence)
+        if validator is not None:
+            out = replace(out, validator=validator)
+        if version is not None:
+            out = replace(out, version=version)
+        return out
+
+    def marshal(self) -> bytes:
+        return (
+            proto.Writer()
+            .message(1, self.block.marshal(), always=True)
+            .message(2, self.evidence.marshal(), always=True)
+            .message(3, self.validator.marshal(), always=True)
+            .message(4, self.version.marshal(), always=True)
+            .out()
+        )
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "ConsensusParams":
+        f = proto.fields(buf)
+        bf = proto.fields(f.get(1, [b""])[-1])
+        block = BlockParams(
+            max_bytes=proto.as_sint64(bf.get(1, [0])[-1]),
+            max_gas=proto.as_sint64(bf.get(2, [0])[-1]),
+            time_iota_ms=proto.as_sint64(bf.get(3, [0])[-1]),
+        )
+        ef = proto.fields(f.get(2, [b""])[-1])
+        durf = proto.fields(ef.get(2, [b""])[-1])
+        evidence = EvidenceParams(
+            max_age_num_blocks=proto.as_sint64(ef.get(1, [0])[-1]),
+            max_age_duration_ns=proto.as_sint64(durf.get(1, [0])[-1]) * 1_000_000_000
+            + proto.as_sint64(durf.get(2, [0])[-1]),
+            max_bytes=proto.as_sint64(ef.get(3, [0])[-1]),
+        )
+        vf = proto.fields(f.get(3, [b""])[-1])
+        validator = ValidatorParams(
+            pub_key_types=tuple(b.decode() for b in vf.get(1, []))
+        )
+        verf = proto.fields(f.get(4, [b""])[-1])
+        version = VersionParams(app_version=verf.get(1, [0])[-1])
+        return ConsensusParams(block, evidence, validator, version)
+
+
+DEFAULT_CONSENSUS_PARAMS = ConsensusParams()

@@ -1,0 +1,118 @@
+"""PartSet: block serialization into 64kB parts with Merkle proofs
+(reference: types/part_set.go:150, types/params.go:17 BlockPartSizeBytes)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.types.block_id import PartSetHeader
+
+BLOCK_PART_SIZE_BYTES = 65536
+MAX_BLOCK_SIZE_BYTES = 104857600
+MAX_BLOCK_PARTS_COUNT = MAX_BLOCK_SIZE_BYTES // BLOCK_PART_SIZE_BYTES + 1
+
+
+@dataclass
+class Part:
+    index: int
+    bytes_: bytes
+    proof: merkle.Proof
+
+    def validate_basic(self) -> None:
+        if len(self.bytes_) > BLOCK_PART_SIZE_BYTES:
+            raise ValueError("part bytes too big")
+        if self.proof.leaf_hash != merkle.leaf_hash(self.bytes_):
+            raise ValueError("wrong proof leaf hash")
+
+    def marshal(self) -> bytes:
+        pw = (
+            proto.Writer()
+            .varint(1, self.proof.total)
+            .varint(2, self.proof.index)
+            .bytes(3, self.proof.leaf_hash)
+        )
+        for a in self.proof.aunts:
+            pw.bytes(4, a)
+        return (
+            proto.Writer()
+            .uvarint(1, self.index)
+            .bytes(2, self.bytes_)
+            .message(3, pw.out(), always=True)
+            .out()
+        )
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "Part":
+        f = proto.fields(buf)
+        pf = proto.fields(f.get(3, [b""])[-1])
+        return Part(
+            index=f.get(1, [0])[-1],
+            bytes_=f.get(2, [b""])[-1],
+            proof=merkle.Proof(
+                total=proto.as_sint64(pf.get(1, [0])[-1]),
+                index=proto.as_sint64(pf.get(2, [0])[-1]),
+                leaf_hash=pf.get(3, [b""])[-1],
+                aunts=list(pf.get(4, [])),
+            ),
+        )
+
+
+class PartSet:
+    """Complete (from data) or incomplete (from header, filled by gossip)."""
+
+    def __init__(self, header: PartSetHeader):
+        self._header = header
+        self.parts: list[Part | None] = [None] * header.total
+        self.count = 0
+        self.byte_size = 0
+
+    @staticmethod
+    def from_data(data: bytes, part_size: int = BLOCK_PART_SIZE_BYTES) -> "PartSet":
+        """reference: types/part_set.go NewPartSetFromData."""
+        chunks = [data[i : i + part_size] for i in range(0, len(data), part_size)] or [b""]
+        root, proofs = merkle.proofs_from_byte_slices(chunks)
+        ps = PartSet(PartSetHeader(total=len(chunks), hash=root))
+        for i, (chunk, proof) in enumerate(zip(chunks, proofs)):
+            ps.parts[i] = Part(index=i, bytes_=chunk, proof=proof)
+        ps.count = len(chunks)
+        ps.byte_size = len(data)
+        return ps
+
+    @staticmethod
+    def from_header(header: PartSetHeader) -> "PartSet":
+        return PartSet(header)
+
+    def header(self) -> PartSetHeader:
+        return self._header
+
+    def has_header(self, header: PartSetHeader) -> bool:
+        return self._header == header
+
+    def add_part(self, part: Part) -> bool:
+        """Verify + insert; False if duplicate (reference: types/part_set.go
+        AddPart)."""
+        if part.index >= self._header.total:
+            raise ValueError("error part set unexpected index")
+        if self.parts[part.index] is not None:
+            return False
+        part.proof.verify(self._header.hash, part.bytes_)
+        self.parts[part.index] = part
+        self.count += 1
+        self.byte_size += len(part.bytes_)
+        return True
+
+    def get_part(self, index: int) -> Part | None:
+        return self.parts[index]
+
+    def is_complete(self) -> bool:
+        return self.count == self._header.total
+
+    def bit_array(self) -> list[bool]:
+        return [p is not None for p in self.parts]
+
+    def assemble(self) -> bytes:
+        if not self.is_complete():
+            raise ValueError("cannot assemble incomplete part set")
+        return b"".join(p.bytes_ for p in self.parts)

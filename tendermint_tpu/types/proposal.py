@@ -1,0 +1,92 @@
+"""Proposal and its canonical sign-bytes (reference: types/proposal.go,
+types/canonical.go:41, proto/tendermint/types/canonical.proto)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.vote import PROPOSAL_TYPE, canonical_block_id_bytes
+
+
+def canonical_proposal_bytes(chain_id: str, height: int, round_: int,
+                             pol_round: int, block_id: BlockID,
+                             timestamp: Time) -> bytes:
+    w = proto.Writer()
+    w.varint(1, PROPOSAL_TYPE)
+    w.sfixed64(2, height)
+    w.sfixed64(3, round_)
+    w.varint(4, pol_round)
+    cbid = canonical_block_id_bytes(block_id)
+    if cbid is not None:
+        w.message(5, cbid, always=True)
+    w.message(6, timestamp.marshal(), always=True)
+    w.string(7, chain_id)
+    return proto.delimited(w.out())
+
+
+@dataclass
+class Proposal:
+    type: int = PROPOSAL_TYPE
+    height: int = 0
+    round: int = 0
+    pol_round: int = -1
+    block_id: BlockID = field(default_factory=BlockID)
+    timestamp: Time = field(default_factory=Time.zero)
+    signature: bytes = b""
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return canonical_proposal_bytes(
+            chain_id, self.height, self.round, self.pol_round, self.block_id, self.timestamp
+        )
+
+    def validate_basic(self) -> None:
+        if self.type != PROPOSAL_TYPE:
+            raise ValueError("invalid Type")
+        if self.height < 0:
+            raise ValueError("negative Height")
+        if self.round < 0:
+            raise ValueError("negative Round")
+        if self.pol_round < -1:
+            raise ValueError("negative POLRound (exception: -1)")
+        self.block_id.validate_basic()
+        if not self.block_id.is_complete():
+            raise ValueError("expected a complete, non-empty BlockID")
+        if not self.signature:
+            raise ValueError("signature is missing")
+        if len(self.signature) > 64:
+            raise ValueError("signature is too big")
+
+    def marshal(self) -> bytes:
+        return (
+            proto.Writer()
+            .varint(1, self.type)
+            .varint(2, self.height)
+            .varint(3, self.round)
+            .varint(4, self.pol_round)
+            .message(5, self.block_id.marshal(), always=True)
+            .message(6, self.timestamp.marshal(), always=True)
+            .bytes(7, self.signature)
+            .out()
+        )
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "Proposal":
+        f = proto.fields(buf)
+        return Proposal(
+            type=f.get(1, [PROPOSAL_TYPE])[-1],
+            height=proto.as_sint64(f.get(2, [0])[-1]),
+            round=proto.as_sint64(f.get(3, [0])[-1]),
+            pol_round=proto.as_sint64(f.get(4, [0])[-1]),
+            block_id=BlockID.unmarshal(f.get(5, [b""])[-1]),
+            timestamp=Time.unmarshal(f.get(6, [b""])[-1]),
+            signature=f.get(7, [b""])[-1],
+        )
+
+    def __str__(self) -> str:
+        return (
+            f"Proposal{{{self.height}/{self.round} ({self.block_id}, "
+            f"{self.pol_round}) {self.signature.hex()[:12]} @ {self.timestamp}}}"
+        )

@@ -1,0 +1,35 @@
+"""Tx / Txs (reference: types/tx.go)."""
+
+from __future__ import annotations
+
+from tendermint_tpu.crypto import merkle, tmhash
+
+
+def tx_hash(tx: bytes) -> bytes:
+    """reference: types/tx.go:29 -- SHA-256 of the raw tx bytes."""
+    return tmhash.sum(tx)
+
+
+def tx_key(tx: bytes) -> bytes:
+    return tmhash.sum(tx)
+
+
+def txs_hash(txs: list[bytes]) -> bytes:
+    """Merkle root over per-tx hashes (reference: types/tx.go:47-55)."""
+    return merkle.hash_from_byte_slices([tx_hash(t) for t in txs])
+
+
+def txs_proof(txs: list[bytes], i: int):
+    root, proofs = merkle.proofs_from_byte_slices([tx_hash(t) for t in txs])
+    return root, proofs[i]
+
+
+def compute_proto_size_overhead(field_count: int = 1) -> int:
+    return field_count
+
+
+def total_tx_bytes(txs: list[bytes]) -> int:
+    """Wire size when embedded in Data (field 1, repeated bytes)."""
+    from tendermint_tpu.encoding.proto import encode_uvarint
+
+    return sum(1 + len(encode_uvarint(len(t))) + len(t) for t in txs)

@@ -1,0 +1,439 @@
+"""ValidatorSet: ordering, proposer rotation, and the batched commit
+verification paths (reference: types/validator_set.go:70,107-180,660-830).
+
+The three Verify* entry points are where the reference burns one serial
+ed25519 verify per validator (~70-100us each). Here every signature needed by
+the serial decision procedure is queued into one BatchVerifier flush (one TPU
+kernel launch), and the reference's *exact* accept/reject + error-attribution
+semantics are then replayed over the returned bitmap:
+
+ - VerifyCommit checks ALL signatures (incentivization, see reference comment
+   types/validator_set.go:662-666) and fails on the first invalid index;
+ - VerifyCommitLight / VerifyCommitLightTrusting stop tallying at +2/3 - in
+   the serial code later signatures are NEVER verified, so an invalid
+   signature after the threshold does not fail the call. We reproduce that by
+   ignoring bitmap entries past the serial stopping point.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto import batch as crypto_batch
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.validator import (
+    MAX_TOTAL_VOTING_POWER,
+    PRIORITY_WINDOW_SIZE_FACTOR,
+    Validator,
+    clip_int64,
+)
+
+MAX_VOTES_COUNT = 10000
+
+
+class ValidatorSetError(Exception):
+    pass
+
+
+class ErrNotEnoughVotingPowerSigned(ValidatorSetError):
+    def __init__(self, got: int, needed: int):
+        super().__init__(f"invalid commit -- insufficient voting power: got {got}, needed more than {needed}")
+        self.got = got
+        self.needed = needed
+
+
+class ErrInvalidCommitSignatures(ValidatorSetError):
+    def __init__(self, have: int, want: int):
+        super().__init__(f"invalid commit -- wrong set size: {have} vs {want}")
+
+
+class ErrInvalidCommitHeight(ValidatorSetError):
+    def __init__(self, want: int, got: int):
+        super().__init__(f"invalid commit -- wrong height: {want} vs {got}")
+
+
+class ErrWrongSignature(ValidatorSetError):
+    def __init__(self, idx: int, sig: bytes):
+        super().__init__(f"wrong signature (#{idx}): {sig.hex().upper()}")
+        self.index = idx
+
+
+class ValidatorSet:
+    """Sorted by voting power desc, then address asc. Not thread-safe."""
+
+    def __init__(self, validators: list[Validator] | None = None):
+        self.validators: list[Validator] = []
+        self.proposer: Validator | None = None
+        self._total_voting_power = 0
+        if validators is not None:
+            self._update_with_change_set(
+                [v.copy() for v in validators], allow_deletes=False
+            )
+            if validators:
+                self.increment_proposer_priority(1)
+
+    # --- basic accessors ---------------------------------------------------
+
+    def size(self) -> int:
+        return len(self.validators)
+
+    def __len__(self) -> int:
+        return len(self.validators)
+
+    def is_nil_or_empty(self) -> bool:
+        return len(self.validators) == 0
+
+    def has_address(self, address: bytes) -> bool:
+        return any(v.address == address for v in self.validators)
+
+    def get_by_address(self, address: bytes) -> tuple[int, Validator | None]:
+        for i, v in enumerate(self.validators):
+            if v.address == address:
+                return i, v.copy()
+        return -1, None
+
+    def get_by_index(self, index: int) -> tuple[bytes | None, Validator | None]:
+        if index < 0 or index >= len(self.validators):
+            return None, None
+        v = self.validators[index]
+        return v.address, v.copy()
+
+    def total_voting_power(self) -> int:
+        if self._total_voting_power == 0:
+            self._update_total_voting_power()
+        return self._total_voting_power
+
+    def _update_total_voting_power(self) -> None:
+        s = 0
+        for v in self.validators:
+            s = clip_int64(s + v.voting_power)
+            if s > MAX_TOTAL_VOTING_POWER:
+                raise ValidatorSetError(
+                    f"total voting power exceeds max {MAX_TOTAL_VOTING_POWER}: {s}"
+                )
+        self._total_voting_power = s
+
+    def copy(self) -> "ValidatorSet":
+        new = ValidatorSet()
+        new.validators = [v.copy() for v in self.validators]
+        new.proposer = self.proposer
+        new._total_voting_power = self._total_voting_power
+        return new
+
+    def validate_basic(self) -> None:
+        if self.is_nil_or_empty():
+            raise ValidatorSetError("validator set is nil or empty")
+        for i, v in enumerate(self.validators):
+            try:
+                v.validate_basic()
+            except ValueError as e:
+                raise ValidatorSetError(f"invalid validator #{i}: {e}") from e
+        if self.proposer is None:
+            raise ValidatorSetError("proposer failed validate basic: nil")
+        self.proposer.validate_basic()
+
+    def hash(self) -> bytes:
+        """Merkle root over SimpleValidator marshals (reference:
+        types/validator_set.go:346-353)."""
+        return merkle.hash_from_byte_slices([v.bytes() for v in self.validators])
+
+    # --- proposer rotation (reference: types/validator_set.go:107-245) -----
+
+    def get_proposer(self) -> Validator | None:
+        if not self.validators:
+            return None
+        if self.proposer is None:
+            self.proposer = self._find_proposer()
+        return self.proposer.copy()
+
+    def _find_proposer(self) -> Validator:
+        proposer = None
+        for v in self.validators:
+            if proposer is None or v.address != proposer.address:
+                proposer = v.compare_proposer_priority(proposer)
+        return proposer
+
+    def increment_proposer_priority(self, times: int) -> None:
+        if self.is_nil_or_empty():
+            raise ValidatorSetError("empty validator set")
+        if times <= 0:
+            raise ValidatorSetError("cannot call with non-positive times")
+        diff_max = PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power()
+        self.rescale_priorities(diff_max)
+        self._shift_by_avg_proposer_priority()
+        proposer = None
+        for _ in range(times):
+            proposer = self._increment_proposer_priority()
+        self.proposer = proposer
+
+    def copy_increment_proposer_priority(self, times: int) -> "ValidatorSet":
+        c = self.copy()
+        c.increment_proposer_priority(times)
+        return c
+
+    def rescale_priorities(self, diff_max: int) -> None:
+        if diff_max <= 0:
+            return
+        diff = self._max_min_priority_diff()
+        ratio = (diff + diff_max - 1) // diff_max
+        if diff > diff_max:
+            for v in self.validators:
+                # Go integer division truncates toward zero.
+                q = abs(v.proposer_priority) // ratio
+                v.proposer_priority = q if v.proposer_priority >= 0 else -q
+
+    def _max_min_priority_diff(self) -> int:
+        prios = [v.proposer_priority for v in self.validators]
+        return abs(max(prios) - min(prios))
+
+    def _shift_by_avg_proposer_priority(self) -> None:
+        n = len(self.validators)
+        # Floor-divide like Go big.Int Div (Euclidean for positive divisor).
+        total = sum(v.proposer_priority for v in self.validators)
+        avg = total // n if total >= 0 else -((-total + n - 1) // n)
+        for v in self.validators:
+            v.proposer_priority = clip_int64(v.proposer_priority - avg)
+
+    def _increment_proposer_priority(self) -> Validator:
+        for v in self.validators:
+            v.proposer_priority = clip_int64(v.proposer_priority + v.voting_power)
+        mostest = None
+        for v in self.validators:
+            mostest = v.compare_proposer_priority(mostest)
+        mostest.proposer_priority = clip_int64(
+            mostest.proposer_priority - self.total_voting_power()
+        )
+        return mostest
+
+    # --- updates (reference: types/validator_set.go:398-650) ---------------
+
+    def update_with_change_set(self, changes: list[Validator]) -> None:
+        self._update_with_change_set([c.copy() for c in changes], allow_deletes=True)
+
+    def _update_with_change_set(self, changes: list[Validator], allow_deletes: bool) -> None:
+        if not changes:
+            return
+        changes_sorted = sorted(changes, key=lambda v: v.address)
+        for a, b in zip(changes_sorted, changes_sorted[1:]):
+            if a.address == b.address:
+                raise ValidatorSetError(f"duplicate entry {b} in changes")
+        updates, removals = [], []
+        for c in changes_sorted:
+            if c.voting_power < 0:
+                raise ValidatorSetError("voting power can't be negative")
+            if c.voting_power > MAX_TOTAL_VOTING_POWER:
+                raise ValidatorSetError(
+                    f"to prevent clipping/overflow, voting power can't be higher than {MAX_TOTAL_VOTING_POWER}"
+                )
+            if c.voting_power == 0:
+                removals.append(c)
+            else:
+                updates.append(c)
+        if removals and not allow_deletes:
+            raise ValidatorSetError(f"cannot process validators with voting power 0: {removals}")
+        for r in removals:
+            if not self.has_address(r.address):
+                raise ValidatorSetError(
+                    f"failed to find validator {r.address.hex()} to remove"
+                )
+
+        # verifyUpdates: check the updated total doesn't overflow.
+        delta = 0
+        by_addr = {v.address: v for v in self.validators}
+        for u in updates:
+            prev = by_addr.get(u.address)
+            delta += u.voting_power - (prev.voting_power if prev else 0)
+        removed_power = sum(
+            by_addr[r.address].voting_power for r in removals if r.address in by_addr
+        )
+        new_total = self.total_voting_power() + delta - removed_power if self.validators else sum(
+            u.voting_power for u in updates
+        )
+        if new_total > MAX_TOTAL_VOTING_POWER:
+            raise ValidatorSetError(
+                f"total voting power of resulting valset exceeds max {MAX_TOTAL_VOTING_POWER}"
+            )
+
+        # computeNewPriorities: new validators start at -1.125 * new total.
+        for u in updates:
+            prev = by_addr.get(u.address)
+            if prev is None:
+                u.proposer_priority = -(new_total + (new_total >> 3))
+            else:
+                u.proposer_priority = prev.proposer_priority
+
+        # apply: merge + delete, re-sort by (power desc, address asc).
+        removal_addrs = {r.address for r in removals}
+        merged = {v.address: v for v in self.validators}
+        for u in updates:
+            merged[u.address] = u
+        for addr in removal_addrs:
+            merged.pop(addr, None)
+        self.validators = sorted(
+            merged.values(), key=lambda v: (-v.voting_power, v.address)
+        )
+        self._total_voting_power = 0
+        self._update_total_voting_power()
+        if updates or removals:
+            # Only rescale/recenter when something changed (updateWithChangeSet
+            # tail, reference types/validator_set.go:628-644).
+            self.rescale_priorities(PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power())
+            self._shift_by_avg_proposer_priority()
+
+    # --- commit verification (the TPU hot path) ----------------------------
+
+    def verify_commit(self, chain_id: str, block_id: BlockID, height: int, commit) -> None:
+        """Checks ALL signatures; first bad index wins (reference:
+        types/validator_set.go:660-715)."""
+        if self.size() != len(commit.signatures):
+            raise ErrInvalidCommitSignatures(self.size(), len(commit.signatures))
+        if height != commit.height:
+            raise ErrInvalidCommitHeight(height, commit.height)
+        if block_id != commit.block_id:
+            raise ValidatorSetError(
+                f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
+            )
+        verifier = crypto_batch.create_batch_verifier()
+        queued: list[int] = []
+        for idx, cs in enumerate(commit.signatures):
+            if cs.absent():
+                continue
+            verifier.add(
+                self.validators[idx].pub_key,
+                commit.vote_sign_bytes(chain_id, idx),
+                cs.signature,
+            )
+            queued.append(idx)
+        _, bitmap = verifier.verify()
+        ok_by_idx = dict(zip(queued, bitmap))
+
+        tallied = 0
+        needed = self.total_voting_power() * 2 // 3
+        for idx, cs in enumerate(commit.signatures):
+            if cs.absent():
+                continue
+            if not ok_by_idx[idx]:
+                raise ErrWrongSignature(idx, cs.signature)
+            if cs.for_block():
+                tallied += self.validators[idx].voting_power
+        if tallied <= needed:
+            raise ErrNotEnoughVotingPowerSigned(tallied, needed)
+
+    def verify_commit_light(self, chain_id: str, block_id: BlockID, height: int, commit) -> None:
+        """Stops at +2/3 like the serial code: signatures past the serial
+        stopping point are not consulted (reference:
+        types/validator_set.go:719-766)."""
+        if self.size() != len(commit.signatures):
+            raise ErrInvalidCommitSignatures(self.size(), len(commit.signatures))
+        if height != commit.height:
+            raise ErrInvalidCommitHeight(height, commit.height)
+        if block_id != commit.block_id:
+            raise ValidatorSetError(
+                f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
+            )
+        needed = self.total_voting_power() * 2 // 3
+
+        # Serial semantics: only indexes up to the threshold-crossing one are
+        # ever verified. Pre-compute that prefix, batch only it.
+        prefix: list[int] = []
+        tallied_scan = 0
+        for idx, cs in enumerate(commit.signatures):
+            if not cs.for_block():
+                continue
+            prefix.append(idx)
+            tallied_scan += self.validators[idx].voting_power
+            if tallied_scan > needed:
+                break
+
+        verifier = crypto_batch.create_batch_verifier()
+        for idx in prefix:
+            verifier.add(
+                self.validators[idx].pub_key,
+                commit.vote_sign_bytes(chain_id, idx),
+                commit.signatures[idx].signature,
+            )
+        _, bitmap = verifier.verify()
+
+        tallied = 0
+        for idx, ok in zip(prefix, bitmap):
+            if not ok:
+                raise ErrWrongSignature(idx, commit.signatures[idx].signature)
+            tallied += self.validators[idx].voting_power
+            if tallied > needed:
+                return
+        raise ErrNotEnoughVotingPowerSigned(tallied, needed)
+
+    def verify_commit_light_trusting(self, chain_id: str, commit, trust_level) -> None:
+        """trust_level of THIS set must have signed (reference:
+        types/validator_set.go:772-830). trust_level: (numerator, denominator)."""
+        num, den = trust_level
+        if den == 0:
+            raise ValidatorSetError("trustLevel has zero Denominator")
+        total_mul = self.total_voting_power() * num
+        if total_mul > 2**63 - 1:
+            raise ValidatorSetError("int64 overflow while calculating voting power needed")
+        needed = total_mul // den
+
+        seen: dict[int, int] = {}
+        prefix: list[tuple[int, int]] = []  # (commit idx, val idx)
+        tallied_scan = 0
+        for idx, cs in enumerate(commit.signatures):
+            if not cs.for_block():
+                continue
+            val_idx, val = self.get_by_address(cs.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen:
+                raise ValidatorSetError(
+                    f"double vote from {val} ({seen[val_idx]} and {idx})"
+                )
+            seen[val_idx] = idx
+            prefix.append((idx, val_idx))
+            tallied_scan += val.voting_power
+            if tallied_scan > needed:
+                break
+
+        verifier = crypto_batch.create_batch_verifier()
+        for idx, val_idx in prefix:
+            verifier.add(
+                self.validators[val_idx].pub_key,
+                commit.vote_sign_bytes(chain_id, idx),
+                commit.signatures[idx].signature,
+            )
+        _, bitmap = verifier.verify()
+
+        tallied = 0
+        for (idx, val_idx), ok in zip(prefix, bitmap):
+            if not ok:
+                raise ErrWrongSignature(idx, commit.signatures[idx].signature)
+            tallied += self.validators[val_idx].voting_power
+            if tallied > needed:
+                return
+        raise ErrNotEnoughVotingPowerSigned(tallied, needed)
+
+    # --- wire --------------------------------------------------------------
+
+    def marshal(self) -> bytes:
+        w = proto.Writer()
+        for v in self.validators:
+            w.message(1, v.marshal())
+        if self.proposer is not None:
+            w.message(2, self.proposer.marshal())
+        w.varint(3, self.total_voting_power())
+        return w.out()
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "ValidatorSet":
+        f = proto.fields(buf)
+        vs = ValidatorSet()
+        vs.validators = [Validator.unmarshal(b) for b in f.get(1, [])]
+        if 2 in f:
+            vs.proposer = Validator.unmarshal(f[2][-1])
+        vs._total_voting_power = 0
+        return vs
+
+    def __str__(self) -> str:
+        prop = self.proposer.address.hex()[:12] if self.proposer else "nil"
+        return f"ValidatorSet{{n={len(self.validators)} proposer={prop}}}"

@@ -1,0 +1,173 @@
+"""Vote and its canonical sign-bytes (reference: types/vote.go:50,93,147,
+types/canonical.go:56, proto/tendermint/types/{types,canonical}.proto).
+
+Sign-bytes are the varint-length-delimited marshal of CanonicalVote:
+  1 type (varint)   2 height (sfixed64)   3 round (sfixed64)
+  4 block_id (nullable: omitted when vote is nil)
+  5 timestamp (non-nullable: always emitted)   6 chain_id
+Byte-compatibility here is what lets the TPU batch verifier reproduce the
+exact signatures the reference network produces.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from tendermint_tpu.crypto import keys
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.ttime import Time
+
+# SignedMsgType (proto/tendermint/types/types.proto:24-37)
+UNKNOWN_TYPE = 0
+PREVOTE_TYPE = 1
+PRECOMMIT_TYPE = 2
+PROPOSAL_TYPE = 32
+
+# BlockIDFlag (proto/tendermint/types/types.proto:13-22)
+BLOCK_ID_FLAG_ABSENT = 1
+BLOCK_ID_FLAG_COMMIT = 2
+BLOCK_ID_FLAG_NIL = 3
+
+MAX_VOTES_COUNT = 10000  # reference: types/validator_set.go MaxVotesCount
+
+
+def is_vote_type_valid(t: int) -> bool:
+    return t in (PREVOTE_TYPE, PRECOMMIT_TYPE)
+
+
+def canonical_block_id_bytes(bid: BlockID) -> bytes | None:
+    """CanonicalBlockID marshal, or None for a zero (nil-vote) BlockID
+    (reference: types/canonical.go:18)."""
+    if bid.is_zero():
+        return None
+    return (
+        proto.Writer()
+        .bytes(1, bid.hash)
+        .message(2, bid.part_set_header.marshal(), always=True)
+        .out()
+    )
+
+
+def canonical_vote_bytes(chain_id: str, vtype: int, height: int, round_: int,
+                         block_id: BlockID, timestamp: Time) -> bytes:
+    """Delimited CanonicalVote marshal = the exact signed payload
+    (reference: types/vote.go:93 VoteSignBytes)."""
+    w = proto.Writer()
+    w.varint(1, vtype)
+    w.sfixed64(2, height)
+    w.sfixed64(3, round_)
+    cbid = canonical_block_id_bytes(block_id)
+    if cbid is not None:
+        w.message(4, cbid, always=True)
+    w.message(5, timestamp.marshal(), always=True)
+    w.string(6, chain_id)
+    return proto.delimited(w.out())
+
+
+@dataclass
+class Vote:
+    type: int = UNKNOWN_TYPE
+    height: int = 0
+    round: int = 0
+    block_id: BlockID = field(default_factory=BlockID)
+    timestamp: Time = field(default_factory=Time.zero)
+    validator_address: bytes = b""
+    validator_index: int = 0
+    signature: bytes = b""
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return canonical_vote_bytes(
+            chain_id, self.type, self.height, self.round, self.block_id, self.timestamp
+        )
+
+    def verify(self, chain_id: str, pub_key: keys.PubKey) -> None:
+        """Reference: types/vote.go:147 -- address match then sig verify."""
+        if pub_key.address() != self.validator_address:
+            raise VoteError("invalid validator address")
+        if not pub_key.verify_signature(self.sign_bytes(chain_id), self.signature):
+            raise VoteError("invalid signature")
+
+    def validate_basic(self) -> None:
+        if not is_vote_type_valid(self.type):
+            raise VoteError("invalid Type")
+        if self.height < 0:
+            raise VoteError("negative Height")
+        if self.round < 0:
+            raise VoteError("negative Round")
+        if not self.block_id.is_zero():
+            self.block_id.validate_basic()
+            if not self.block_id.is_complete():
+                raise VoteError(f"blockID must be either empty or complete, got: {self.block_id}")
+        if len(self.validator_address) != keys.ADDRESS_SIZE:
+            raise VoteError("expected ValidatorAddress size to be 20 bytes")
+        if self.validator_index < 0:
+            raise VoteError("negative ValidatorIndex")
+        if len(self.signature) == 0:
+            raise VoteError("signature is missing")
+        if len(self.signature) > MAX_SIGNATURE_SIZE:
+            raise VoteError("signature is too big")
+
+    def is_nil(self) -> bool:
+        return self.block_id.is_zero()
+
+    def copy(self) -> "Vote":
+        return replace(self)
+
+    # --- wire (proto/tendermint/types/types.proto Vote) --------------------
+    def marshal(self) -> bytes:
+        return (
+            proto.Writer()
+            .varint(1, self.type)
+            .varint(2, self.height)
+            .varint(3, self.round)
+            .message(4, self.block_id.marshal(), always=True)
+            .message(5, self.timestamp.marshal(), always=True)
+            .bytes(6, self.validator_address)
+            .varint(7, self.validator_index)
+            .bytes(8, self.signature)
+            .out()
+        )
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "Vote":
+        f = proto.fields(buf)
+        return Vote(
+            type=f.get(1, [0])[-1],
+            height=proto.as_sint64(f.get(2, [0])[-1]),
+            round=proto.as_sint64(f.get(3, [0])[-1]),
+            block_id=BlockID.unmarshal(f.get(4, [b""])[-1]),
+            timestamp=Time.unmarshal(f.get(5, [b""])[-1]),
+            validator_address=f.get(6, [b""])[-1],
+            validator_index=proto.as_sint64(f.get(7, [0])[-1]),
+            signature=f.get(8, [b""])[-1],
+        )
+
+    def __str__(self) -> str:
+        kind = {PREVOTE_TYPE: "Prevote", PRECOMMIT_TYPE: "Precommit"}.get(self.type, "?")
+        tgt = "nil" if self.is_nil() else self.block_id.hash.hex()[:12]
+        return (
+            f"Vote{{{self.validator_index}:{self.validator_address.hex()[:12]} "
+            f"{self.height}/{self.round:02d} {kind} {tgt}}}"
+        )
+
+
+MAX_SIGNATURE_SIZE = 64  # largest among ed25519/sr25519/secp256k1 (reference: types/vote.go)
+
+
+class VoteError(Exception):
+    pass
+
+
+class ErrVoteConflictingVotes(VoteError):
+    """Same validator signed two different votes for the same H/R/T
+    (reference: types/vote_set.go:84, the evidence trigger)."""
+
+    def __init__(self, vote_a: Vote, vote_b: Vote):
+        super().__init__(f"conflicting votes: {vote_a} vs {vote_b}")
+        self.vote_a = vote_a
+        self.vote_b = vote_b
+
+
+class ErrVoteNonDeterministicSignature(VoteError):
+    pass

@@ -1,12 +1,30 @@
 """Test configuration: force an 8-device virtual CPU mesh so multi-chip
 sharding paths (jax.sharding.Mesh + shard_map) are exercised without TPU
-hardware. Must run before jax is imported anywhere."""
+hardware.
+
+The runtime image pre-imports jax at interpreter startup (axon sitecustomize
+via PALLAS_AXON_POOL_IPS) and pins JAX_PLATFORMS=axon, so env vars alone are
+too late; the backend is re-targeted via jax.config before any JAX op runs."""
 
 import os
 
+# The in-process jax.config updates below are what take effect for THIS
+# process; the env vars exist so child processes tests spawn (e2e runner,
+# node subprocesses) inherit the same CPU-mesh setup.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+if _xb.backends_are_initialized():
+    # Some earlier import already ran a JAX op; start over in-process.
+    import jax.extend.backend as _jeb
+
+    _jeb.clear_backends()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+assert jax.default_backend() == "cpu" and len(jax.devices()) == 8

@@ -1,0 +1,127 @@
+"""Differential tests: batched TPU-path ed25519 verify vs the scalar reference.
+
+The contract under test is SURVEY.md's hard requirement: byte-identical
+accept/reject decisions between tendermint_tpu.ops.ed25519_batch.verify_batch
+and tendermint_tpu.crypto.ed25519.verify for every input class, including
+malformed and adversarial ones (reference semantics:
+crypto/ed25519/ed25519.go:148)."""
+
+import random
+
+import numpy as np
+
+from tendermint_tpu.crypto import ed25519 as ref
+from tendermint_tpu.ops import ed25519_batch as batch
+
+rng = random.Random(99)
+
+
+def _keypair(i):
+    seed = bytes([i % 256] * 31 + [(i * 7 + 3) % 256])
+    priv = ref.gen_priv_key(seed)
+    return priv, priv.pub_key()
+
+
+def _check(items):
+    got = batch.verify_batch(items)
+    want = np.array([ref.verify(p, m, s) for (p, m, s) in items])
+    assert got.shape == want.shape
+    mism = np.nonzero(got != want)[0]
+    assert mism.size == 0, f"mismatch at {mism[:10]}: got {got[mism[:10]]}"
+
+
+def test_valid_signatures():
+    items = []
+    for i in range(20):
+        priv, pub = _keypair(i)
+        msg = bytes([i]) * (i + 1)
+        items.append((pub.data, msg, ref.sign(priv.data, msg)))
+    got = batch.verify_batch(items)
+    assert got.all()
+    _check(items)
+
+
+def test_mixed_corruptions():
+    items = []
+    for i in range(48):
+        priv, pub = _keypair(i)
+        msg = b"vote-" + bytes([i])
+        sig = bytearray(ref.sign(priv.data, msg))
+        kind = i % 6
+        if kind == 1:  # flip a bit in R
+            sig[rng.randrange(32)] ^= 1 << rng.randrange(8)
+        elif kind == 2:  # flip a bit in S
+            sig[32 + rng.randrange(32)] ^= 1 << rng.randrange(8)
+        elif kind == 3:  # wrong message
+            msg = msg + b"!"
+        elif kind == 4:  # wrong key
+            pub = _keypair(i + 1)[1]
+        elif kind == 5:  # random garbage sig
+            sig = bytearray(rng.randbytes(64))
+        items.append((pub.data, bytes(msg), bytes(sig)))
+    _check(items)
+
+
+def test_adversarial_encodings():
+    priv, pub = _keypair(7)
+    msg = b"edge"
+    sig = ref.sign(priv.data, msg)
+    s_int = int.from_bytes(sig[32:], "little")
+    items = [
+        # s >= L (add L to a valid s: same sig equation, must reject)
+        (pub.data, msg, sig[:32] + (s_int + ref.L).to_bytes(32, "little")),
+        # s = L exactly
+        (pub.data, msg, sig[:32] + ref.L.to_bytes(32, "little")),
+        # non-canonical pubkey: y = p (encodes like 0 but >= p)
+        (ref.P.to_bytes(32, "little"), msg, sig),
+        # pubkey = identity encoding (y=1, valid small-order point)
+        ((1).to_bytes(32, "little"), msg, sig),
+        # pubkey y not on curve
+        ((5).to_bytes(32, "little"), msg, sig),
+        # x=0 with sign bit set (invalid per RFC 8032)
+        ((1 | (1 << 255)).to_bytes(32, "little"), msg, sig),
+        # non-canonical R: y_R >= p
+        (pub.data, msg, ref.P.to_bytes(32, "little") + sig[32:]),
+        # R with sign bit flipped
+        (pub.data, msg, bytes([sig[0], *sig[1:31], sig[31] ^ 0x80]) + sig[32:]),
+        # wrong sizes
+        (pub.data[:-1], msg, sig),
+        (pub.data, msg, sig[:-1]),
+        # zero everything
+        (b"\x00" * 32, b"", b"\x00" * 64),
+        # valid control
+        (pub.data, msg, sig),
+    ]
+    _check(items)
+
+
+def test_small_order_pubkey_signatures():
+    """Signatures under small-order keys: both paths must agree (h is reduced
+    mod L in both, so torsion components behave identically)."""
+    # y = -1 point (order 2): encoding of y = p-1
+    small = (ref.P - 1).to_bytes(32, "little")
+    items = []
+    for i in range(8):
+        r = rng.randbytes(32)
+        s = rng.randrange(ref.L).to_bytes(32, "little")
+        items.append((small, b"m%d" % i, r + s))
+    # forged sig with s=0, R=identity-encoding under small-order key
+    items.append((small, b"x", (1).to_bytes(32, "little") + b"\x00" * 32))
+    _check(items)
+
+
+def test_large_batch_with_padding():
+    """Crosses a bucket boundary (70 -> padded 128)."""
+    items = []
+    for i in range(70):
+        priv, pub = _keypair(i % 9)
+        msg = b"batch-%d" % i
+        sig = ref.sign(priv.data, msg)
+        if i % 7 == 0:
+            sig = sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]
+        items.append((pub.data, msg, sig))
+    _check(items)
+
+
+def test_empty_batch():
+    assert batch.verify_batch([]).shape == (0,)

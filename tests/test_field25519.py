@@ -1,0 +1,85 @@
+"""Differential tests: limb field arithmetic vs Python bignum ground truth."""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from tendermint_tpu.ops import field25519 as fe
+
+P = fe.P
+rng = random.Random(1234)
+
+
+def _rand_ints(n):
+    vals = [rng.randrange(P) for _ in range(n - 4)]
+    vals += [0, 1, P - 1, 2**255 - 20]  # edge values
+    return vals
+
+
+def _to_dev(vals):
+    return jnp.asarray(np.stack([fe.from_int(v) for v in vals]))
+
+
+def test_roundtrip():
+    vals = _rand_ints(16)
+    arr = _to_dev(vals)
+    for i, v in enumerate(vals):
+        assert fe.to_int(np.asarray(arr[i])) == v % P
+
+
+def test_mul_add_sub():
+    a_vals = _rand_ints(32)
+    b_vals = _rand_ints(32)
+    a, b = _to_dev(a_vals), _to_dev(b_vals)
+    m = np.asarray(fe.to_canonical(fe.mul(a, b)))
+    s = np.asarray(fe.to_canonical(fe.add(a, b)))
+    d = np.asarray(fe.to_canonical(fe.sub(a, b)))
+    for i in range(32):
+        assert fe.to_int(m[i]) == a_vals[i] * b_vals[i] % P
+        assert fe.to_int(s[i]) == (a_vals[i] + b_vals[i]) % P
+        assert fe.to_int(d[i]) == (a_vals[i] - b_vals[i]) % P
+
+
+def test_chained_ops_stay_bounded():
+    """Long chains of add/sub/mul keep limbs inside the NORM bound and remain
+    exact -- catches int32 overflow in the bound analysis."""
+    a_vals = _rand_ints(8)
+    b_vals = _rand_ints(8)
+    a, b = _to_dev(a_vals), _to_dev(b_vals)
+    ga, gb = list(a_vals), list(b_vals)
+    for step in range(30):
+        if step % 3 == 0:
+            a = fe.mul(fe.add(a, b), fe.sub(a, b))
+            ga = [(x + y) * (x - y) % P for x, y in zip(ga, gb)]
+        elif step % 3 == 1:
+            b = fe.add(fe.mul(b, b), a)
+            gb = [(y * y + x) % P for x, y in zip(ga, gb)]
+        else:
+            a = fe.sub(fe.mul_small(a, 2), b)
+            ga = [(2 * x - y) % P for x, y in zip(ga, gb)]
+        assert int(jnp.max(a)) < 9500 and int(jnp.max(b)) < 9500
+        assert int(jnp.min(a)) >= 0 and int(jnp.min(b)) >= 0
+    am = np.asarray(fe.to_canonical(a))
+    bm = np.asarray(fe.to_canonical(b))
+    for i in range(8):
+        assert fe.to_int(am[i]) == ga[i]
+        assert fe.to_int(bm[i]) == gb[i]
+
+
+def test_inv():
+    vals = [v for v in _rand_ints(16) if v != 0]
+    a = _to_dev(vals)
+    iv = np.asarray(fe.to_canonical(fe.inv(a)))
+    for i, v in enumerate(vals):
+        assert fe.to_int(iv[i]) == pow(v, P - 2, P)
+
+
+def test_canonical_reduces_below_p():
+    vals = [P - 1, 0, 1, 2**255 - 20, 2**255 - 19]
+    a = _to_dev(vals)
+    c = np.asarray(fe.to_canonical(a))
+    for i, v in enumerate(vals):
+        got = fe.to_int(c[i])
+        assert got == v % P
+        assert got < P
