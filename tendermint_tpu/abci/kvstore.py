@@ -1,0 +1,125 @@
+"""In-process kvstore example application (reference: abci/example/kvstore/
+kvstore.go + persistent_kvstore.go).
+
+Transactions are "key=value" (or raw bytes stored under themselves); a
+"val:<b64pubkey>!<power>" tx updates the validator set, like the reference's
+persistent kvstore. AppHash = big-endian tx count, matching the reference's
+size-based app hash semantics.
+"""
+
+from __future__ import annotations
+
+import base64
+import struct
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.store.db import DB, MemDB
+
+VALIDATOR_TX_PREFIX = b"val:"
+
+
+class KVStoreApplication(abci.Application):
+    def __init__(self, db: DB | None = None):
+        self.db = db if db is not None else MemDB()
+        self.size = 0
+        self.height = 0
+        self.app_hash = b""
+        self.val_updates: list[abci.ValidatorUpdate] = []
+        self.validators: dict[bytes, int] = {}  # pubkey bytes -> power
+        self._load_state()
+
+    # --- state persistence -------------------------------------------------
+
+    def _load_state(self) -> None:
+        raw = self.db.get(b"__state__")
+        if raw:
+            self.size, self.height = struct.unpack(">QQ", raw[:16])
+            self.app_hash = raw[16:]
+
+    def _save_state(self) -> None:
+        self.db.set(b"__state__", struct.pack(">QQ", self.size, self.height) + self.app_hash)
+
+    # --- ABCI --------------------------------------------------------------
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=f'{{"size":{self.size}}}',
+            version="0.1.0",
+            app_version=1,
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash if self.height else b"",
+        )
+
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        for vu in req.validators:
+            self._apply_validator_update(vu)
+        return abci.ResponseInitChain()
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        if req.tx.startswith(VALIDATOR_TX_PREFIX) and not self._parse_val_tx(req.tx):
+            return abci.ResponseCheckTx(code=1, log="invalid validator tx")
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=1)
+
+    def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        self.val_updates = []
+        return abci.ResponseBeginBlock()
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        tx = req.tx
+        if tx.startswith(VALIDATOR_TX_PREFIX):
+            parsed = self._parse_val_tx(tx)
+            if not parsed:
+                return abci.ResponseDeliverTx(code=1, log="invalid validator tx")
+            vu = abci.ValidatorUpdate("ed25519", parsed[0], parsed[1])
+            self.val_updates.append(vu)
+            self._apply_validator_update(vu)
+        else:
+            if b"=" in tx:
+                k, v = tx.split(b"=", 1)
+            else:
+                k = v = tx
+            self.db.set(b"kv:" + k, v)
+        self.size += 1
+        events = [abci.Event(type="app", attributes=[
+            abci.EventAttribute(key=b"creator", value=b"kvstore", index=True),
+        ])]
+        return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK, events=events)
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        return abci.ResponseEndBlock(validator_updates=list(self.val_updates))
+
+    def commit(self) -> abci.ResponseCommit:
+        self.app_hash = struct.pack(">Q", self.size)
+        self.height += 1
+        self._save_state()
+        return abci.ResponseCommit(data=self.app_hash)
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        if req.path == "/val":
+            power = self.validators.get(req.data, 0)
+            return abci.ResponseQuery(code=0, key=req.data, value=str(power).encode())
+        v = self.db.get(b"kv:" + req.data)
+        if v is None:
+            return abci.ResponseQuery(code=0, key=req.data, log="does not exist")
+        return abci.ResponseQuery(code=0, key=req.data, value=v, log="exists")
+
+    # --- helpers -----------------------------------------------------------
+
+    def _apply_validator_update(self, vu: abci.ValidatorUpdate) -> None:
+        if vu.power == 0:
+            self.validators.pop(vu.pub_key_bytes, None)
+        else:
+            self.validators[vu.pub_key_bytes] = vu.power
+
+    @staticmethod
+    def _parse_val_tx(tx: bytes):
+        try:
+            body = tx[len(VALIDATOR_TX_PREFIX):].decode()
+            pk_b64, power_s = body.split("!", 1)
+            return base64.b64decode(pk_b64), int(power_s)
+        except Exception:  # noqa: BLE001
+            return None
+
+    @staticmethod
+    def make_val_tx(pub_key_bytes: bytes, power: int) -> bytes:
+        return VALIDATOR_TX_PREFIX + base64.b64encode(pub_key_bytes) + b"!%d" % power

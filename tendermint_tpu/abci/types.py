@@ -1,0 +1,430 @@
+"""ABCI: the application boundary (reference: abci/types/application.go:11-32,
+proto/tendermint/abci/types.proto).
+
+The 13-method Application interface plus request/response dataclasses. The
+deterministic subset of ResponseDeliverTx (code/data/gas) feeds
+LastResultsHash exactly as the reference's deterministicResponseDeliverTx
+(types/results.go:32-43).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.encoding import proto
+
+CODE_TYPE_OK = 0
+
+
+@dataclass
+class EventAttribute:
+    key: bytes = b""
+    value: bytes = b""
+    index: bool = False
+
+    def marshal(self) -> bytes:
+        return (
+            proto.Writer().bytes(1, self.key).bytes(2, self.value).bool(3, self.index).out()
+        )
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "EventAttribute":
+        f = proto.fields(buf)
+        return EventAttribute(
+            key=f.get(1, [b""])[-1], value=f.get(2, [b""])[-1],
+            index=bool(f.get(3, [0])[-1]),
+        )
+
+
+@dataclass
+class Event:
+    type: str = ""
+    attributes: list[EventAttribute] = field(default_factory=list)
+
+    def marshal(self) -> bytes:
+        w = proto.Writer().string(1, self.type)
+        for a in self.attributes:
+            w.message(2, a.marshal(), always=True)
+        return w.out()
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "Event":
+        f = proto.fields(buf)
+        return Event(
+            type=f.get(1, [b""])[-1].decode() if 1 in f else "",
+            attributes=[EventAttribute.unmarshal(b) for b in f.get(2, [])],
+        )
+
+
+@dataclass
+class ValidatorUpdate:
+    pub_key_type: str
+    pub_key_bytes: bytes
+    power: int
+
+    def marshal(self) -> bytes:
+        fieldnum = {"ed25519": 1, "secp256k1": 2}[self.pub_key_type]
+        pk = proto.Writer().bytes(fieldnum, self.pub_key_bytes).out()
+        return proto.Writer().message(1, pk, always=True).varint(2, self.power).out()
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "ValidatorUpdate":
+        f = proto.fields(buf)
+        pkf = proto.fields(f.get(1, [b""])[-1])
+        if 1 in pkf:
+            kt, kb = "ed25519", pkf[1][-1]
+        elif 2 in pkf:
+            kt, kb = "secp256k1", pkf[2][-1]
+        else:
+            raise ValueError("empty pubkey in ValidatorUpdate")
+        return ValidatorUpdate(kt, kb, proto.as_sint64(f.get(2, [0])[-1]))
+
+
+@dataclass
+class ABCIValidator:
+    """abci.Validator: 20-byte address + power (types.proto:341-347)."""
+
+    address: bytes = b""
+    power: int = 0
+
+
+@dataclass
+class VoteInfo:
+    validator: ABCIValidator
+    signed_last_block: bool = False
+
+
+@dataclass
+class LastCommitInfo:
+    round: int = 0
+    votes: list[VoteInfo] = field(default_factory=list)
+
+
+EVIDENCE_TYPE_UNKNOWN = 0
+EVIDENCE_TYPE_DUPLICATE_VOTE = 1
+EVIDENCE_TYPE_LIGHT_CLIENT_ATTACK = 2
+
+
+@dataclass
+class ABCIEvidence:
+    type: int = EVIDENCE_TYPE_UNKNOWN
+    validator: ABCIValidator = field(default_factory=ABCIValidator)
+    height: int = 0
+    time_seconds: int = 0
+    time_nanos: int = 0
+    total_voting_power: int = 0
+
+
+# --- requests ---------------------------------------------------------------
+
+
+@dataclass
+class RequestInfo:
+    version: str = ""
+    block_version: int = 0
+    p2p_version: int = 0
+
+
+@dataclass
+class RequestInitChain:
+    time_seconds: int = 0
+    time_nanos: int = 0
+    chain_id: str = ""
+    consensus_params: object | None = None
+    validators: list[ValidatorUpdate] = field(default_factory=list)
+    app_state_bytes: bytes = b""
+    initial_height: int = 0
+
+
+@dataclass
+class RequestQuery:
+    data: bytes = b""
+    path: str = ""
+    height: int = 0
+    prove: bool = False
+
+
+@dataclass
+class RequestBeginBlock:
+    hash: bytes = b""
+    header: object | None = None  # types.Header
+    last_commit_info: LastCommitInfo = field(default_factory=LastCommitInfo)
+    byzantine_validators: list[ABCIEvidence] = field(default_factory=list)
+
+
+CHECK_TX_TYPE_NEW = 0
+CHECK_TX_TYPE_RECHECK = 1
+
+
+@dataclass
+class RequestCheckTx:
+    tx: bytes = b""
+    type: int = CHECK_TX_TYPE_NEW
+
+
+@dataclass
+class RequestDeliverTx:
+    tx: bytes = b""
+
+
+@dataclass
+class RequestEndBlock:
+    height: int = 0
+
+
+@dataclass
+class RequestListSnapshots:
+    pass
+
+
+@dataclass
+class Snapshot:
+    height: int = 0
+    format: int = 0
+    chunks: int = 0
+    hash: bytes = b""
+    metadata: bytes = b""
+
+
+@dataclass
+class RequestOfferSnapshot:
+    snapshot: Snapshot | None = None
+    app_hash: bytes = b""
+
+
+@dataclass
+class RequestLoadSnapshotChunk:
+    height: int = 0
+    format: int = 0
+    chunk: int = 0
+
+
+@dataclass
+class RequestApplySnapshotChunk:
+    index: int = 0
+    chunk: bytes = b""
+    sender: str = ""
+
+
+# --- responses --------------------------------------------------------------
+
+
+@dataclass
+class ResponseInfo:
+    data: str = ""
+    version: str = ""
+    app_version: int = 0
+    last_block_height: int = 0
+    last_block_app_hash: bytes = b""
+
+
+@dataclass
+class ResponseSetOption:
+    code: int = 0
+    log: str = ""
+    info: str = ""
+
+
+@dataclass
+class ResponseInitChain:
+    consensus_params: object | None = None
+    validators: list[ValidatorUpdate] = field(default_factory=list)
+    app_hash: bytes = b""
+
+
+@dataclass
+class ResponseQuery:
+    code: int = 0
+    log: str = ""
+    info: str = ""
+    index: int = 0
+    key: bytes = b""
+    value: bytes = b""
+    proof_ops: object | None = None
+    height: int = 0
+    codespace: str = ""
+
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+
+@dataclass
+class ResponseBeginBlock:
+    events: list[Event] = field(default_factory=list)
+
+
+@dataclass
+class ResponseCheckTx:
+    code: int = 0
+    data: bytes = b""
+    log: str = ""
+    info: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+    events: list[Event] = field(default_factory=list)
+    codespace: str = ""
+    sender: str = ""
+    priority: int = 0
+    mempool_error: str = ""
+
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+
+@dataclass
+class ResponseDeliverTx:
+    code: int = 0
+    data: bytes = b""
+    log: str = ""
+    info: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+    events: list[Event] = field(default_factory=list)
+    codespace: str = ""
+
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+    def deterministic_marshal(self) -> bytes:
+        """Strip nondeterministic fields before hashing into LastResultsHash
+        (reference: types/results.go:32-43)."""
+        return (
+            proto.Writer()
+            .uvarint(1, self.code)
+            .bytes(2, self.data)
+            .varint(5, self.gas_wanted)
+            .varint(6, self.gas_used)
+            .out()
+        )
+
+    def marshal(self) -> bytes:
+        w = (
+            proto.Writer()
+            .uvarint(1, self.code)
+            .bytes(2, self.data)
+            .string(3, self.log)
+            .string(4, self.info)
+            .varint(5, self.gas_wanted)
+            .varint(6, self.gas_used)
+        )
+        for e in self.events:
+            w.message(7, e.marshal(), always=True)
+        w.string(8, self.codespace)
+        return w.out()
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "ResponseDeliverTx":
+        f = proto.fields(buf)
+        return ResponseDeliverTx(
+            code=f.get(1, [0])[-1],
+            data=f.get(2, [b""])[-1],
+            log=f.get(3, [b""])[-1].decode() if 3 in f else "",
+            info=f.get(4, [b""])[-1].decode() if 4 in f else "",
+            gas_wanted=proto.as_sint64(f.get(5, [0])[-1]),
+            gas_used=proto.as_sint64(f.get(6, [0])[-1]),
+            events=[Event.unmarshal(b) for b in f.get(7, [])],
+            codespace=f.get(8, [b""])[-1].decode() if 8 in f else "",
+        )
+
+
+@dataclass
+class ResponseEndBlock:
+    validator_updates: list[ValidatorUpdate] = field(default_factory=list)
+    consensus_param_updates: object | None = None
+    events: list[Event] = field(default_factory=list)
+
+
+@dataclass
+class ResponseCommit:
+    data: bytes = b""
+    retain_height: int = 0
+
+
+OFFER_SNAPSHOT_UNKNOWN = 0
+OFFER_SNAPSHOT_ACCEPT = 1
+OFFER_SNAPSHOT_ABORT = 2
+OFFER_SNAPSHOT_REJECT = 3
+OFFER_SNAPSHOT_REJECT_FORMAT = 4
+OFFER_SNAPSHOT_REJECT_SENDER = 5
+
+APPLY_CHUNK_UNKNOWN = 0
+APPLY_CHUNK_ACCEPT = 1
+APPLY_CHUNK_ABORT = 2
+APPLY_CHUNK_RETRY = 3
+APPLY_CHUNK_RETRY_SNAPSHOT = 4
+APPLY_CHUNK_REJECT_SNAPSHOT = 5
+
+
+@dataclass
+class ResponseListSnapshots:
+    snapshots: list[Snapshot] = field(default_factory=list)
+
+
+@dataclass
+class ResponseOfferSnapshot:
+    result: int = OFFER_SNAPSHOT_UNKNOWN
+
+
+@dataclass
+class ResponseLoadSnapshotChunk:
+    chunk: bytes = b""
+
+
+@dataclass
+class ResponseApplySnapshotChunk:
+    result: int = APPLY_CHUNK_UNKNOWN
+    refetch_chunks: list[int] = field(default_factory=list)
+    reject_senders: list[str] = field(default_factory=list)
+
+
+class Application:
+    """The 13-method ABCI application interface (reference:
+    abci/types/application.go:11-32). Subclass and override."""
+
+    def info(self, req: RequestInfo) -> ResponseInfo:
+        return ResponseInfo()
+
+    def set_option(self, key: str, value: str) -> ResponseSetOption:
+        return ResponseSetOption()
+
+    def query(self, req: RequestQuery) -> ResponseQuery:
+        return ResponseQuery()
+
+    def check_tx(self, req: RequestCheckTx) -> ResponseCheckTx:
+        return ResponseCheckTx()
+
+    def init_chain(self, req: RequestInitChain) -> ResponseInitChain:
+        return ResponseInitChain()
+
+    def begin_block(self, req: RequestBeginBlock) -> ResponseBeginBlock:
+        return ResponseBeginBlock()
+
+    def deliver_tx(self, req: RequestDeliverTx) -> ResponseDeliverTx:
+        return ResponseDeliverTx()
+
+    def end_block(self, req: RequestEndBlock) -> ResponseEndBlock:
+        return ResponseEndBlock()
+
+    def commit(self) -> ResponseCommit:
+        return ResponseCommit()
+
+    def list_snapshots(self, req: RequestListSnapshots) -> ResponseListSnapshots:
+        return ResponseListSnapshots()
+
+    def offer_snapshot(self, req: RequestOfferSnapshot) -> ResponseOfferSnapshot:
+        return ResponseOfferSnapshot()
+
+    def load_snapshot_chunk(self, req: RequestLoadSnapshotChunk) -> ResponseLoadSnapshotChunk:
+        return ResponseLoadSnapshotChunk()
+
+    def apply_snapshot_chunk(self, req: RequestApplySnapshotChunk) -> ResponseApplySnapshotChunk:
+        return ResponseApplySnapshotChunk()
+
+
+def results_hash(responses: list[ResponseDeliverTx]) -> bytes:
+    """LastResultsHash (reference: types/results.go ABCIResults.Hash)."""
+    from tendermint_tpu.crypto import merkle
+
+    return merkle.hash_from_byte_slices(
+        [r.deterministic_marshal() for r in responses]
+    )

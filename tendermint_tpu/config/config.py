@@ -1,0 +1,242 @@
+"""Node configuration (reference: config/config.go:66-96,923-1100).
+
+Flat dataclasses mirroring the reference's TOML sections; see
+tendermint_tpu.config.toml for the file rendering.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class BaseConfig:
+    """reference: config/config.go:180-300."""
+
+    root_dir: str = ""
+    proxy_app: str = "kvstore"
+    moniker: str = "anonymous"
+    fast_sync_mode: bool = True
+    db_backend: str = "sqlite"
+    db_dir: str = "data"
+    log_level: str = "info"
+    log_format: str = "plain"
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    priv_validator_laddr: str = ""
+    node_key_file: str = "config/node_key.json"
+    abci: str = "socket"
+    filter_peers: bool = False
+
+    def resolve(self, path: str) -> str:
+        return path if os.path.isabs(path) else os.path.join(self.root_dir, path)
+
+
+@dataclass
+class RPCConfig:
+    """reference: config/config.go:320-480."""
+
+    laddr: str = "tcp://127.0.0.1:26657"
+    cors_allowed_origins: tuple = ()
+    grpc_laddr: str = ""
+    grpc_max_open_connections: int = 900
+    unsafe: bool = False
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit_s: float = 10.0
+    max_body_bytes: int = 1000000
+    max_header_bytes: int = 1 << 20
+    pprof_laddr: str = ""
+
+
+@dataclass
+class P2PConfig:
+    """reference: config/config.go:500-640."""
+
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""
+    persistent_peers: str = ""
+    upnp: bool = False
+    addr_book_file: str = "config/addrbook.json"
+    addr_book_strict: bool = True
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    unconditional_peer_ids: str = ""
+    persistent_peers_max_dial_period_s: float = 0.0
+    flush_throttle_timeout_s: float = 0.1
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5120000
+    recv_rate: int = 5120000
+    pex: bool = True
+    seed_mode: bool = False
+    private_peer_ids: str = ""
+    allow_duplicate_ip: bool = False
+    handshake_timeout_s: float = 20.0
+    dial_timeout_s: float = 3.0
+
+
+@dataclass
+class MempoolConfig:
+    """reference: config/config.go:660-760."""
+
+    version: str = "v0"
+    recheck: bool = True
+    broadcast: bool = True
+    wal_dir: str = ""
+    size: int = 5000
+    max_txs_bytes: int = 1024 * 1024 * 1024
+    cache_size: int = 10000
+    keep_invalid_txs_in_cache: bool = False
+    max_tx_bytes: int = 1024 * 1024
+    max_batch_bytes: int = 0
+    ttl_duration_s: float = 0.0
+    ttl_num_blocks: int = 0
+
+
+@dataclass
+class StateSyncConfig:
+    """reference: config/config.go:780-860."""
+
+    enable: bool = False
+    temp_dir: str = ""
+    rpc_servers: tuple = ()
+    trust_period_s: float = 168 * 3600.0
+    trust_height: int = 0
+    trust_hash: str = ""
+    discovery_time_s: float = 15.0
+    chunk_request_timeout_s: float = 10.0
+    chunk_fetchers: int = 4
+
+
+@dataclass
+class FastSyncConfig:
+    """reference: config/config.go:880-910."""
+
+    version: str = "v0"
+
+
+@dataclass
+class ConsensusConfig:
+    """Timeouts in seconds (reference: config/config.go:923-1050)."""
+
+    wal_path: str = "data/cs.wal"
+    timeout_propose_s: float = 3.0
+    timeout_propose_delta_s: float = 0.5
+    timeout_prevote_s: float = 1.0
+    timeout_prevote_delta_s: float = 0.5
+    timeout_precommit_s: float = 1.0
+    timeout_precommit_delta_s: float = 0.5
+    timeout_commit_s: float = 1.0
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval_s: float = 0.0
+    peer_gossip_sleep_duration_s: float = 0.1
+    peer_query_maj23_sleep_duration_s: float = 2.0
+    double_sign_check_height: int = 0
+
+    # reference: config/config.go Propose/Prevote/Precommit/Commit helpers
+    def propose(self, round_: int) -> float:
+        return self.timeout_propose_s + self.timeout_propose_delta_s * round_
+
+    def prevote(self, round_: int) -> float:
+        return self.timeout_prevote_s + self.timeout_prevote_delta_s * round_
+
+    def precommit(self, round_: int) -> float:
+        return self.timeout_precommit_s + self.timeout_precommit_delta_s * round_
+
+    def commit_time_s(self) -> float:
+        return self.timeout_commit_s
+
+    def wait_for_txs(self) -> bool:
+        return not self.create_empty_blocks or self.create_empty_blocks_interval_s > 0
+
+
+@dataclass
+class StorageConfig:
+    discard_abci_responses: bool = False
+
+
+@dataclass
+class TxIndexConfig:
+    indexer: str = "kv"
+    psql_conn: str = ""
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    max_open_connections: int = 3
+    namespace: str = "tendermint"
+
+
+@dataclass
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    fastsync: FastSyncConfig = field(default_factory=FastSyncConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
+
+    def set_root(self, root: str) -> "Config":
+        self.base.root_dir = root
+        return self
+
+    def genesis_file(self) -> str:
+        return self.base.resolve(self.base.genesis_file)
+
+    def priv_validator_key_file(self) -> str:
+        return self.base.resolve(self.base.priv_validator_key_file)
+
+    def priv_validator_state_file(self) -> str:
+        return self.base.resolve(self.base.priv_validator_state_file)
+
+    def node_key_file(self) -> str:
+        return self.base.resolve(self.base.node_key_file)
+
+    def db_dir(self) -> str:
+        return self.base.resolve(self.base.db_dir)
+
+    def wal_file(self) -> str:
+        return self.base.resolve(self.consensus.wal_path)
+
+    def validate_basic(self) -> None:
+        for name, v in (
+            ("timeout_propose", self.consensus.timeout_propose_s),
+            ("timeout_prevote", self.consensus.timeout_prevote_s),
+            ("timeout_precommit", self.consensus.timeout_precommit_s),
+            ("timeout_commit", self.consensus.timeout_commit_s),
+        ):
+            if v < 0:
+                raise ValueError(f"{name} can't be negative")
+        if self.mempool.size < 0:
+            raise ValueError("mempool size can't be negative")
+
+
+def default_config() -> Config:
+    return Config()
+
+
+def test_config() -> Config:
+    """Fast timeouts for in-process tests (reference: config/config.go
+    TestConfig)."""
+    c = Config()
+    c.consensus.timeout_propose_s = 0.8
+    c.consensus.timeout_propose_delta_s = 0.1
+    c.consensus.timeout_prevote_s = 0.2
+    c.consensus.timeout_prevote_delta_s = 0.1
+    c.consensus.timeout_precommit_s = 0.2
+    c.consensus.timeout_precommit_delta_s = 0.1
+    c.consensus.timeout_commit_s = 0.05
+    c.consensus.skip_timeout_commit = True
+    c.base.db_backend = "memdb"
+    return c

@@ -1,0 +1,166 @@
+"""Consensus-internal types: round steps, RoundState, HeightVoteSet
+(reference: consensus/types/round_state.go, consensus/types/height_vote_set.go).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from tendermint_tpu.types.block import Block, Commit
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE, Vote, is_vote_type_valid
+from tendermint_tpu.types.vote_set import VoteSet
+
+# RoundStepType (reference: consensus/types/round_state.go:13-40)
+STEP_NEW_HEIGHT = 1
+STEP_NEW_ROUND = 2
+STEP_PROPOSE = 3
+STEP_PREVOTE = 4
+STEP_PREVOTE_WAIT = 5
+STEP_PRECOMMIT = 6
+STEP_PRECOMMIT_WAIT = 7
+STEP_COMMIT = 8
+
+STEP_NAMES = {
+    STEP_NEW_HEIGHT: "RoundStepNewHeight",
+    STEP_NEW_ROUND: "RoundStepNewRound",
+    STEP_PROPOSE: "RoundStepPropose",
+    STEP_PREVOTE: "RoundStepPrevote",
+    STEP_PREVOTE_WAIT: "RoundStepPrevoteWait",
+    STEP_PRECOMMIT: "RoundStepPrecommit",
+    STEP_PRECOMMIT_WAIT: "RoundStepPrecommitWait",
+    STEP_COMMIT: "RoundStepCommit",
+}
+
+
+@dataclass
+class RoundState:
+    """reference: consensus/types/round_state.go:65-120."""
+
+    height: int = 0
+    round: int = 0
+    step: int = STEP_NEW_HEIGHT
+    start_time: Time = field(default_factory=Time.zero)
+    commit_time: Time = field(default_factory=Time.zero)
+    validators: ValidatorSet | None = None
+    proposal: Proposal | None = None
+    proposal_block: Block | None = None
+    proposal_block_parts: PartSet | None = None
+    locked_round: int = -1
+    locked_block: Block | None = None
+    locked_block_parts: PartSet | None = None
+    valid_round: int = -1
+    valid_block: Block | None = None
+    valid_block_parts: PartSet | None = None
+    votes: "HeightVoteSet | None" = None
+    commit_round: int = -1
+    last_commit: VoteSet | None = None
+    last_validators: ValidatorSet | None = None
+    triggered_timeout_precommit: bool = False
+
+    def step_name(self) -> str:
+        return STEP_NAMES.get(self.step, f"Unknown({self.step})")
+
+
+class HeightVoteSetError(Exception):
+    pass
+
+
+class ErrGotVoteFromUnwantedRound(HeightVoteSetError):
+    def __init__(self):
+        super().__init__("peer has sent a vote that does not match our round for more than one round")
+
+
+class HeightVoteSet:
+    """Prevotes + precommits for every round of one height, with bounded
+    peer catch-up rounds (reference: consensus/types/height_vote_set.go:34-200)."""
+
+    def __init__(self, chain_id: str, height: int, val_set: ValidatorSet):
+        self.chain_id = chain_id
+        self._mtx = threading.RLock()
+        self.reset(height, val_set)
+
+    def reset(self, height: int, val_set: ValidatorSet) -> None:
+        with self._mtx:
+            self.height = height
+            self.val_set = val_set
+            self.round = 0
+            self.round_vote_sets: dict[int, tuple[VoteSet, VoteSet]] = {}
+            self.peer_catchup_rounds: dict[str, list[int]] = {}
+            self._add_round(0)
+
+    def _add_round(self, round_: int) -> None:
+        if round_ in self.round_vote_sets:
+            raise HeightVoteSetError("addRound() for an existing round")
+        prevotes = VoteSet(self.chain_id, self.height, round_, PREVOTE_TYPE, self.val_set)
+        precommits = VoteSet(self.chain_id, self.height, round_, PRECOMMIT_TYPE, self.val_set)
+        self.round_vote_sets[round_] = (prevotes, precommits)
+
+    def set_round(self, round_: int) -> None:
+        """Creates vote sets up to round_ (reference: height_vote_set.go:86-100)."""
+        with self._mtx:
+            new_round = self.round - 1
+            if self.round != 0 and round_ < new_round:
+                raise HeightVoteSetError("SetRound() must increment hvs.round")
+            for r in range(max(new_round, 0), round_ + 1):
+                if r not in self.round_vote_sets:
+                    self._add_round(r)
+            self.round = round_
+
+    def add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """reference: height_vote_set.go:117-150."""
+        with self._mtx:
+            if not is_vote_type_valid(vote.type):
+                return False
+            vote_set = self._get_vote_set(vote.round, vote.type)
+            if vote_set is None:
+                rndz = self.peer_catchup_rounds.get(peer_id, [])
+                if len(rndz) < 2:
+                    self._add_round(vote.round)
+                    vote_set = self._get_vote_set(vote.round, vote.type)
+                    rndz.append(vote.round)
+                    self.peer_catchup_rounds[peer_id] = rndz
+                else:
+                    raise ErrGotVoteFromUnwantedRound()
+            return vote_set.add_vote(vote)
+
+    def prevotes(self, round_: int) -> VoteSet | None:
+        with self._mtx:
+            return self._get_vote_set(round_, PREVOTE_TYPE)
+
+    def precommits(self, round_: int) -> VoteSet | None:
+        with self._mtx:
+            return self._get_vote_set(round_, PRECOMMIT_TYPE)
+
+    def pol_info(self) -> tuple[int, BlockID]:
+        """Last round with a prevote maj23 (reference: height_vote_set.go:153-164)."""
+        with self._mtx:
+            for r in range(self.round, -1, -1):
+                rvs = self._get_vote_set(r, PREVOTE_TYPE)
+                if rvs is not None:
+                    bid, ok = rvs.two_thirds_majority()
+                    if ok:
+                        return r, bid
+            return -1, BlockID()
+
+    def _get_vote_set(self, round_: int, vote_type: int) -> VoteSet | None:
+        rvs = self.round_vote_sets.get(round_)
+        if rvs is None:
+            return None
+        return rvs[0] if vote_type == PREVOTE_TYPE else rvs[1]
+
+    def set_peer_maj23(self, round_: int, vote_type: int, peer_id: str,
+                       block_id: BlockID) -> None:
+        """reference: height_vote_set.go:185-200."""
+        with self._mtx:
+            if not is_vote_type_valid(vote_type):
+                raise HeightVoteSetError(f"SetPeerMaj23: invalid vote type {vote_type}")
+            vote_set = self._get_vote_set(round_, vote_type)
+            if vote_set is None:
+                return
+            vote_set.set_peer_maj23(peer_id, block_id)

@@ -1,0 +1,1042 @@
+"""The Tendermint BFT consensus state machine.
+
+A single consumer thread serializes every input (peer messages, own messages,
+timeouts) exactly like the reference's receiveRoutine (reference:
+consensus/state.go:707-790); all enter* transitions run on that thread. The
+round step grammar, POL locking/unlocking rules, and WAL write points follow
+consensus/state.go line-by-line semantics (citations inline), re-derived
+against spec/consensus/consensus.md.
+
+Differences from the reference are TPU-era, not semantic:
+ - vote verification inside VoteSet can run through the batched TPU verifier;
+ - goroutine fans are replaced by one input queue + a timer thread.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time as _time
+from dataclasses import dataclass
+
+from tendermint_tpu.consensus import cstypes
+from tendermint_tpu.consensus.cstypes import (
+    STEP_COMMIT,
+    STEP_NEW_HEIGHT,
+    STEP_NEW_ROUND,
+    STEP_PRECOMMIT,
+    STEP_PRECOMMIT_WAIT,
+    STEP_PREVOTE,
+    STEP_PREVOTE_WAIT,
+    STEP_PROPOSE,
+    HeightVoteSet,
+)
+from tendermint_tpu.consensus.ticker import TimeoutInfo, TimeoutTicker
+from tendermint_tpu.consensus.wal import WAL, EndHeightMessage, WALMessageBlob
+from tendermint_tpu.config.config import ConsensusConfig
+from tendermint_tpu.encoding import proto as proto_enc
+from tendermint_tpu.types import events as tmevents
+from tendermint_tpu.types.block import Block, Commit
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.part_set import Part, PartSet
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import (
+    PRECOMMIT_TYPE,
+    PREVOTE_TYPE,
+    ErrVoteConflictingVotes,
+    Vote,
+)
+from tendermint_tpu.types.vote_set import VoteSet
+
+
+class ConsensusError(Exception):
+    pass
+
+
+class ErrInvalidProposalPOLRound(ConsensusError):
+    pass
+
+
+class ErrInvalidProposalSignature(ConsensusError):
+    pass
+
+
+class ErrAddingVote(ConsensusError):
+    pass
+
+
+# --- message types (reference: consensus/msgs.go) ---------------------------
+
+
+@dataclass
+class ProposalMessage:
+    proposal: Proposal
+
+    def wal_blob(self) -> WALMessageBlob:
+        return WALMessageBlob("proposal", self.proposal.marshal())
+
+
+@dataclass
+class BlockPartMessage:
+    height: int
+    round: int
+    part: Part
+
+    def wal_blob(self) -> WALMessageBlob:
+        body = (
+            proto_enc.Writer()
+            .varint(1, self.height)
+            .varint(2, self.round)
+            .message(3, self.part.marshal(), always=True)
+            .out()
+        )
+        return WALMessageBlob("block_part", body)
+
+
+@dataclass
+class VoteMessage:
+    vote: Vote
+
+    def wal_blob(self) -> WALMessageBlob:
+        return WALMessageBlob("vote", self.vote.marshal())
+
+
+def wal_blob_to_msg(blob: WALMessageBlob):
+    if blob.kind == "proposal":
+        return ProposalMessage(Proposal.unmarshal(blob.payload))
+    if blob.kind == "block_part":
+        f = proto_enc.fields(blob.payload)
+        return BlockPartMessage(
+            height=proto_enc.as_sint64(f.get(1, [0])[-1]),
+            round=proto_enc.as_sint64(f.get(2, [0])[-1]),
+            part=Part.unmarshal(f.get(3, [b""])[-1]),
+        )
+    if blob.kind == "vote":
+        return VoteMessage(Vote.unmarshal(blob.payload))
+    if blob.kind == "timeout":
+        f = proto_enc.fields(blob.payload)
+        return TimeoutInfo(
+            duration_s=proto_enc.as_sint64(f.get(1, [0])[-1]) / 1e9,
+            height=proto_enc.as_sint64(f.get(2, [0])[-1]),
+            round=proto_enc.as_sint64(f.get(3, [0])[-1]),
+            step=proto_enc.as_sint64(f.get(4, [0])[-1]),
+        )
+    return None
+
+
+def timeout_wal_blob(ti: TimeoutInfo) -> WALMessageBlob:
+    body = (
+        proto_enc.Writer()
+        .varint(1, int(ti.duration_s * 1e9))
+        .varint(2, ti.height)
+        .varint(3, ti.round)
+        .varint(4, ti.step)
+        .out()
+    )
+    return WALMessageBlob("timeout", body)
+
+
+@dataclass
+class MsgInfo:
+    msg: object
+    peer_id: str = ""
+
+
+def commit_to_vote_set(chain_id: str, commit: Commit, vals: ValidatorSet) -> VoteSet:
+    """reference: types/vote_set.go CommitToVoteSet (via types/block.go)."""
+    vote_set = VoteSet(chain_id, commit.height, commit.round, PRECOMMIT_TYPE, vals)
+    for idx, cs_sig in enumerate(commit.signatures):
+        if cs_sig.absent():
+            continue
+        added = vote_set.add_vote(commit.get_vote(idx))
+        if not added:
+            raise ConsensusError("failed to reconstruct LastCommit: duplicate vote")
+    return vote_set
+
+
+class ConsensusState:
+    """reference: consensus/state.go:149 State."""
+
+    def __init__(self, config: ConsensusConfig, state, block_exec, block_store,
+                 mempool=None, evidence_pool=None, priv_validator=None,
+                 event_bus=None, wal: WAL | None = None, logger=None):
+        self.config = config
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.mempool = mempool
+        self.evpool = evidence_pool
+        self.priv_validator = priv_validator
+        self.priv_validator_pub_key = (
+            priv_validator.get_pub_key() if priv_validator else None
+        )
+        self.event_bus = event_bus if event_bus is not None else tmevents.EventBus()
+        self.wal = wal
+        self.logger = logger
+
+        self.rs = cstypes.RoundState()
+        self.state = None  # sm.State; set by update_to_state
+
+        self._msg_queue: queue.Queue = queue.Queue(maxsize=1000)
+        self._internal_queue: queue.Queue = queue.Queue(maxsize=1000)
+        self._ticker = TimeoutTicker(self._on_timeout_fired)
+        self._timeout_queue: queue.Queue = queue.Queue()
+        self._mtx = threading.RLock()
+        self._thread: threading.Thread | None = None
+        self._running = False
+        self.replay_mode = False
+        self._n_steps = 0
+        # decided-block callback fans (reactor hooks; reference evsw usage)
+        self.on_new_round_step = []  # callbacks(rs)
+        self.on_vote = []  # callbacks(vote)
+        self.on_valid_block = []  # callbacks(rs)
+        # called with each internally-generated message (own proposal, parts,
+        # votes) for the reactor / test harness to gossip to peers
+        self.broadcast = None
+
+        if state is not None:
+            # reconstruct LastCommit when resuming mid-chain (reference:
+            # consensus/state.go:540-570 reconstructLastCommit)
+            if state.last_block_height > 0:
+                seen = block_store.load_seen_commit(state.last_block_height)
+                if seen is None:
+                    raise ConsensusError(
+                        f"failed to reconstruct last commit; seen commit for height "
+                        f"{state.last_block_height} not found"
+                    )
+                last_precommits = commit_to_vote_set(
+                    state.chain_id, seen, state.last_validators
+                )
+                if not last_precommits.has_two_thirds_majority():
+                    raise ConsensusError(
+                        "failed to reconstruct last commit; does not have +2/3 maj"
+                    )
+                self.rs.last_commit = last_precommits
+            self.update_to_state(state)
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """reference: consensus/state.go:299-420 OnStart + startRoutines."""
+        if self.wal is not None and self.state is not None:
+            # Empty WAL gets a height-0 end marker so crash replay works for
+            # the very first height (reference: consensus/wal.go OnStart).
+            if next(iter(self.wal.iter_messages()), None) is None:
+                self.wal.write_sync(EndHeightMessage(0), _time.time_ns())
+            self._catchup_replay(self.rs.height)
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._receive_routine, name="cs-receive", daemon=True
+        )
+        self._thread.start()
+        self._schedule_round_0()
+
+    def stop(self) -> None:
+        self._running = False
+        self._ticker.stop()
+        self._msg_queue.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self.wal is not None:
+            self.wal.close()
+
+    def wait_sync(self, timeout: float = 1.0) -> None:
+        """Drain the queues (test helper): returns once queued work at call
+        time has been handled."""
+        done = threading.Event()
+        self._msg_queue.put(("__sync__", done))
+        done.wait(timeout)
+
+    # --- external input (reference: consensus/state.go:430-520) ------------
+
+    def add_vote(self, vote: Vote, peer_id: str = "") -> None:
+        q = self._internal_queue if peer_id == "" else self._msg_queue
+        q.put(MsgInfo(VoteMessage(vote), peer_id))
+
+    def set_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
+        q = self._internal_queue if peer_id == "" else self._msg_queue
+        q.put(MsgInfo(ProposalMessage(proposal), peer_id))
+
+    def add_proposal_block_part(self, height: int, round_: int, part: Part,
+                                peer_id: str = "") -> None:
+        q = self._internal_queue if peer_id == "" else self._msg_queue
+        q.put(MsgInfo(BlockPartMessage(height, round_, part), peer_id))
+
+    def handle_txs_available(self) -> None:
+        self._msg_queue.put(("__txs_available__", None))
+
+    # --- round state snapshot ---------------------------------------------
+
+    def get_round_state(self) -> cstypes.RoundState:
+        with self._mtx:
+            import copy
+
+            return copy.copy(self.rs)
+
+    # --- the serialized event loop -----------------------------------------
+
+    def _receive_routine(self) -> None:
+        """reference: consensus/state.go:707-790. Strict ordering: internal
+        queue drains before the peer queue; timeouts interleave."""
+        while self._running:
+            mi = None
+            try:
+                mi = self._internal_queue.get_nowait()
+                internal = True
+            except queue.Empty:
+                internal = False
+            if mi is None:
+                try:
+                    ti = self._timeout_queue.get_nowait()
+                    self._do_handle_timeout(ti)
+                    continue
+                except queue.Empty:
+                    pass
+                try:
+                    mi = self._msg_queue.get(timeout=0.02)
+                except queue.Empty:
+                    continue
+            if mi is None:
+                return  # stop sentinel
+            if isinstance(mi, tuple):
+                kind, payload = mi
+                if kind == "__sync__":
+                    if not self._internal_queue.empty() or not self._timeout_queue.empty():
+                        self._msg_queue.put(mi)  # drain internals first
+                    else:
+                        payload.set()
+                elif kind == "__txs_available__":
+                    with self._mtx:
+                        self._handle_txs_available()
+                continue
+            # WAL discipline (reference: state.go:753-780): internal messages
+            # are fsync'd, peer messages buffered.
+            if self.wal is not None and not self.replay_mode:
+                blob = mi.msg.wal_blob()
+                blob.peer_id = mi.peer_id
+                if internal:
+                    self.wal.write_sync(blob, _time.time_ns())
+                else:
+                    self.wal.write(blob, _time.time_ns())
+            with self._mtx:
+                self._handle_msg(mi)
+
+    def _on_timeout_fired(self, ti: TimeoutInfo) -> None:
+        # hop onto the consensus thread
+        if self.wal is not None and not self.replay_mode:
+            self.wal.write(timeout_wal_blob(ti), _time.time_ns())
+        self._timeout_queue.put(ti)
+
+    def _handle_msg(self, mi: MsgInfo) -> None:
+        """reference: consensus/state.go:799-890."""
+        msg, peer_id = mi.msg, mi.peer_id
+        try:
+            if isinstance(msg, ProposalMessage):
+                self._set_proposal(msg.proposal)
+            elif isinstance(msg, BlockPartMessage):
+                added = self._add_proposal_block_part(msg)
+                if added and self.rs.proposal_block_parts.is_complete():
+                    self._handle_complete_proposal(msg.height)
+            elif isinstance(msg, VoteMessage):
+                self._try_add_vote(msg.vote, peer_id)
+        except Exception as e:  # noqa: BLE001
+            # The reference logs and continues (consensus/state.go:880-890):
+            # a bad peer message (invalid sig, wrong index, unwanted round...)
+            # must never kill the consensus thread.
+            if self.logger is not None:
+                self.logger.error("failed to process message", err=e, peer=peer_id)
+
+    def _do_handle_timeout(self, ti: TimeoutInfo) -> None:
+        """reference: consensus/state.go:890-940 handleTimeout."""
+        with self._mtx:
+            rs = self.rs
+            if (ti.height != rs.height or ti.round < rs.round
+                    or (ti.round == rs.round and ti.step < rs.step)):
+                return
+            if ti.step == STEP_NEW_HEIGHT:
+                self._enter_new_round(ti.height, 0)
+            elif ti.step == STEP_NEW_ROUND:
+                self._enter_propose(ti.height, 0)
+            elif ti.step == STEP_PROPOSE:
+                self.event_bus.publish_event_timeout_propose(self._round_state_event())
+                self._enter_prevote(ti.height, ti.round)
+            elif ti.step == STEP_PREVOTE_WAIT:
+                self.event_bus.publish_event_timeout_wait(self._round_state_event())
+                self._enter_precommit(ti.height, ti.round)
+            elif ti.step == STEP_PRECOMMIT_WAIT:
+                self.event_bus.publish_event_timeout_wait(self._round_state_event())
+                self._enter_precommit(ti.height, ti.round)
+                self._enter_new_round(ti.height, ti.round + 1)
+
+    def _handle_txs_available(self) -> None:
+        """reference: consensus/state.go:940-975."""
+        if self.rs.round != 0:
+            return
+        if self.rs.step == STEP_NEW_HEIGHT:
+            if self._need_proof_block(self.rs.height):
+                return
+            remain = max(self.rs.start_time.unix_ns() - _time.time_ns(), 0) / 1e9
+            self._schedule_timeout(remain + 0.001, self.rs.height, 0, STEP_NEW_ROUND)
+        elif self.rs.step == STEP_NEW_ROUND:
+            self._enter_propose(self.rs.height, 0)
+
+    # --- state update ------------------------------------------------------
+
+    def update_to_state(self, state) -> None:
+        """reference: consensus/state.go:573-700 updateToState."""
+        rs = self.rs
+        if rs.commit_round > -1 and 0 < rs.height and rs.height != state.last_block_height:
+            raise ConsensusError(
+                f"updateToState() expected state height of {rs.height} but found "
+                f"{state.last_block_height}"
+            )
+        if self.state is not None and not self.state.is_empty():
+            if state.last_block_height <= self.state.last_block_height:
+                self._new_step()
+                return
+
+        validators = state.validators
+        if state.last_block_height == 0:
+            rs.last_commit = None
+        elif rs.commit_round > -1 and rs.votes is not None:
+            precommits = rs.votes.precommits(rs.commit_round)
+            if not precommits.has_two_thirds_majority():
+                raise ConsensusError("wanted to form a commit, but precommits didn't have 2/3+")
+            rs.last_commit = precommits
+
+        height = state.last_block_height + 1
+        if height == 1:
+            height = state.initial_height
+
+        rs.height = height
+        rs.round = 0
+        rs.step = STEP_NEW_HEIGHT
+        now_ns = _time.time_ns()
+        base_ns = rs.commit_time.unix_ns() if not rs.commit_time.is_zero() else now_ns
+        rs.start_time = Time.from_unix_ns(base_ns + int(self.config.commit_time_s() * 1e9))
+        rs.validators = validators
+        rs.proposal = None
+        rs.proposal_block = None
+        rs.proposal_block_parts = None
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        rs.valid_round = -1
+        rs.valid_block = None
+        rs.valid_block_parts = None
+        rs.votes = HeightVoteSet(state.chain_id, height, validators)
+        rs.commit_round = -1
+        rs.last_validators = state.last_validators
+        rs.triggered_timeout_precommit = False
+        self.state = state
+        self._new_step()
+
+    def _new_step(self) -> None:
+        if self.wal is not None and not self.replay_mode:
+            self.wal.write(
+                WALMessageBlob("round_state", b"%d/%d/%d" % (
+                    self.rs.height, self.rs.round, self.rs.step)),
+                _time.time_ns(),
+            )
+        self._n_steps += 1
+        self.event_bus.publish_event_new_round_step(self._round_state_event())
+        for cb in self.on_new_round_step:
+            cb(self.rs)
+
+    def _round_state_event(self) -> tmevents.EventDataRoundState:
+        return tmevents.EventDataRoundState(
+            height=self.rs.height, round=self.rs.round, step=self.rs.step_name()
+        )
+
+    # --- timeout scheduling -------------------------------------------------
+
+    def _schedule_timeout(self, duration_s: float, height: int, round_: int, step: int) -> None:
+        self._ticker.schedule_timeout(TimeoutInfo(duration_s, height, round_, step))
+
+    def _schedule_round_0(self) -> None:
+        """reference: consensus/state.go:522-530."""
+        sleep = max(self.rs.start_time.unix_ns() - _time.time_ns(), 0) / 1e9
+        self._schedule_timeout(sleep, self.rs.height, 0, STEP_NEW_HEIGHT)
+
+    # --- ENTER: transitions -------------------------------------------------
+
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        """reference: consensus/state.go:976-1037."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+                rs.round == round_ and rs.step != STEP_NEW_HEIGHT):
+            return
+
+        validators = rs.validators
+        if rs.round < round_:
+            validators = validators.copy()
+            validators.increment_proposer_priority(round_ - rs.round)
+
+        rs.round = round_
+        rs.step = STEP_NEW_ROUND
+        rs.validators = validators
+        if round_ != 0:
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_ + 1)  # track next round for round-skipping
+        rs.triggered_timeout_precommit = False
+
+        proposer = validators.get_proposer()
+        self.event_bus.publish_event_new_round(tmevents.EventDataNewRound(
+            height=height, round=round_, step=rs.step_name(),
+            proposer_address=proposer.address if proposer else b"",
+        ))
+
+        wait_for_txs = (self.config.wait_for_txs() and round_ == 0
+                        and not self._need_proof_block(height))
+        if wait_for_txs:
+            if self.config.create_empty_blocks_interval_s > 0:
+                self._schedule_timeout(self.config.create_empty_blocks_interval_s,
+                                       height, round_, STEP_NEW_ROUND)
+            if self.mempool is not None and self.mempool.size() > 0:
+                self._enter_propose(height, round_)
+        else:
+            self._enter_propose(height, round_)
+
+    def _need_proof_block(self, height: int) -> bool:
+        """reference: consensus/state.go:1040-1053."""
+        if height == self.state.initial_height:
+            return True
+        last_meta = self.block_store.load_block_meta(height - 1)
+        if last_meta is None:
+            raise ConsensusError(f"needProofBlock: last block meta for height {height-1} not found")
+        return self.state.app_hash != last_meta.header.app_hash
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        """reference: consensus/state.go:1060-1122."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+                rs.round == round_ and STEP_PROPOSE <= rs.step):
+            return
+        try:
+            self._schedule_timeout(self.config.propose(round_), height, round_, STEP_PROPOSE)
+            if self.priv_validator is None or self.priv_validator_pub_key is None:
+                return
+            address = self.priv_validator_pub_key.address()
+            if not rs.validators.has_address(address):
+                return
+            if rs.validators.get_proposer().address == address:
+                self._decide_proposal(height, round_)
+        finally:
+            rs.round = round_
+            rs.step = STEP_PROPOSE
+            self._new_step()
+            if self._is_proposal_complete():
+                self._enter_prevote(height, rs.round)
+
+    def _decide_proposal(self, height: int, round_: int) -> None:
+        """reference: consensus/state.go:1124-1180 defaultDecideProposal."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, block_parts = rs.valid_block, rs.valid_block_parts
+        else:
+            created = self._create_proposal_block()
+            if created is None:
+                return
+            block, block_parts = created
+        if self.wal is not None:
+            self.wal.flush_and_sync()
+        prop_block_id = BlockID(hash=block.hash(), part_set_header=block_parts.header())
+        proposal = Proposal(height=height, round=round_, pol_round=rs.valid_round,
+                            block_id=prop_block_id, timestamp=Time.now())
+        try:
+            self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except Exception:  # noqa: BLE001 - failed signing is non-fatal
+            if not self.replay_mode:
+                return
+            raise
+        msgs = [MsgInfo(ProposalMessage(proposal), "")]
+        for i in range(block_parts.header().total):
+            part = block_parts.get_part(i)
+            msgs.append(MsgInfo(BlockPartMessage(height, round_, part), ""))
+        for m in msgs:
+            self._internal_queue.put(m)
+            if self.broadcast is not None:
+                self.broadcast(m.msg)
+
+    def _create_proposal_block(self):
+        """reference: consensus/state.go:1189-1223."""
+        rs = self.rs
+        if rs.height == self.state.initial_height:
+            commit = Commit(height=0, round=0, block_id=BlockID(), signatures=[])
+        elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+            commit = rs.last_commit.make_commit()
+        else:
+            return None
+        proposer_addr = self.priv_validator_pub_key.address()
+        block = self.block_exec.create_proposal_block(
+            rs.height, self.state, commit, proposer_addr
+        )
+        parts = PartSet.from_data(block.marshal())
+        return block, parts
+
+    def _is_proposal_complete(self) -> bool:
+        """reference: consensus/state.go:1182-1196."""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        """reference: consensus/state.go:1226-1250."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+                rs.round == round_ and STEP_PREVOTE <= rs.step):
+            return
+        self._do_prevote(height, round_)
+        rs.round = round_
+        rs.step = STEP_PREVOTE
+        self._new_step()
+
+    def _do_prevote(self, height: int, round_: int) -> None:
+        """reference: consensus/state.go:1252-1284 defaultDoPrevote."""
+        rs = self.rs
+        if rs.locked_block is not None:
+            self._sign_add_vote(PREVOTE_TYPE, rs.locked_block.hash(),
+                                rs.locked_block_parts.header())
+            return
+        if rs.proposal_block is None:
+            self._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
+            return
+        try:
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+        except Exception:  # noqa: BLE001 - invalid proposal -> prevote nil
+            self._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
+            return
+        self._sign_add_vote(PREVOTE_TYPE, rs.proposal_block.hash(),
+                            rs.proposal_block_parts.header())
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        """reference: consensus/state.go:1286-1315."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+                rs.round == round_ and STEP_PREVOTE_WAIT <= rs.step):
+            return
+        if not rs.votes.prevotes(round_).has_two_thirds_any():
+            raise ConsensusError(
+                f"entering prevote wait step ({height}/{round_}), but prevotes "
+                "does not have any +2/3 votes"
+            )
+        rs.round = round_
+        rs.step = STEP_PREVOTE_WAIT
+        self._new_step()
+        self._schedule_timeout(self.config.prevote(round_), height, round_, STEP_PREVOTE_WAIT)
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        """reference: consensus/state.go:1322-1417."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+                rs.round == round_ and STEP_PRECOMMIT <= rs.step):
+            return
+
+        def done():
+            rs.round = round_
+            rs.step = STEP_PRECOMMIT
+            self._new_step()
+
+        block_id, ok = rs.votes.prevotes(round_).two_thirds_majority()
+        if not ok:
+            # No polka: precommit nil.
+            self._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
+            done()
+            return
+
+        self.event_bus.publish_event_polka(self._round_state_event())
+        pol_round, _ = rs.votes.pol_info()
+        if pol_round < round_:
+            raise ConsensusError(f"this POLRound should be {round_} but got {pol_round}")
+
+        if len(block_id.hash) == 0:
+            # +2/3 prevoted nil: unlock and precommit nil.
+            if rs.locked_block is not None:
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+                self.event_bus.publish_event_unlock(self._round_state_event())
+            self._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
+            done()
+            return
+
+        if rs.locked_block is not None and rs.locked_block.hashes_to(block_id.hash):
+            # relock
+            rs.locked_round = round_
+            self.event_bus.publish_event_relock(self._round_state_event())
+            self._sign_add_vote(PRECOMMIT_TYPE, block_id.hash, block_id.part_set_header)
+            done()
+            return
+
+        if rs.proposal_block is not None and rs.proposal_block.hashes_to(block_id.hash):
+            # lock the proposal block
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            self.event_bus.publish_event_lock(self._round_state_event())
+            self._sign_add_vote(PRECOMMIT_TYPE, block_id.hash, block_id.part_set_header)
+            done()
+            return
+
+        # Polka for a block we don't have: unlock, fetch, precommit nil.
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                block_id.part_set_header):
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet.from_header(block_id.part_set_header)
+        self.event_bus.publish_event_unlock(self._round_state_event())
+        self._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
+        done()
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        """reference: consensus/state.go:1419-1454."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+                rs.round == round_ and rs.triggered_timeout_precommit):
+            return
+        if not rs.votes.precommits(round_).has_two_thirds_any():
+            raise ConsensusError(
+                f"entering precommit wait step ({height}/{round_}), but precommits "
+                "does not have any +2/3 votes"
+            )
+        rs.triggered_timeout_precommit = True
+        self._new_step()
+        self._schedule_timeout(self.config.precommit(round_), height, round_,
+                               STEP_PRECOMMIT_WAIT)
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        """reference: consensus/state.go:1476-1537."""
+        rs = self.rs
+        if rs.height != height or STEP_COMMIT <= rs.step:
+            return
+
+        block_id, ok = rs.votes.precommits(commit_round).two_thirds_majority()
+        if not ok:
+            raise ConsensusError("RunActionCommit() expects +2/3 precommits")
+
+        if rs.locked_block is not None and rs.locked_block.hashes_to(block_id.hash):
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+
+        if rs.proposal_block is None or not rs.proposal_block.hashes_to(block_id.hash):
+            if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                    block_id.part_set_header):
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet.from_header(block_id.part_set_header)
+                self.event_bus.publish_event_valid_block(self._round_state_event())
+                for cb in self.on_valid_block:
+                    cb(self.rs)
+
+        rs.step = STEP_COMMIT
+        rs.commit_round = commit_round
+        rs.commit_time = Time.now()
+        self._new_step()
+        self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        """reference: consensus/state.go:1539-1565."""
+        rs = self.rs
+        if rs.height != height:
+            raise ConsensusError(f"tryFinalizeCommit() cs.Height: {rs.height} vs {height}")
+        block_id, ok = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        if not ok or len(block_id.hash) == 0:
+            return
+        if rs.proposal_block is None or not rs.proposal_block.hashes_to(block_id.hash):
+            return
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int) -> None:
+        """reference: consensus/state.go:1567-1692."""
+        rs = self.rs
+        if rs.height != height or rs.step != STEP_COMMIT:
+            return
+        block_id, ok = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        block, block_parts = rs.proposal_block, rs.proposal_block_parts
+        if not ok:
+            raise ConsensusError("cannot finalize commit; commit does not have 2/3 majority")
+        if not block_parts.has_header(block_id.part_set_header):
+            raise ConsensusError("expected ProposalBlockParts header to be commit header")
+        if not block.hashes_to(block_id.hash):
+            raise ConsensusError("cannot finalize commit; proposal block does not hash to commit hash")
+        self.block_exec.validate_block(self.state, block)
+
+        from tendermint_tpu.utils import fail
+
+        fail.fail_point()  # crash site 1 (reference: state.go:1605)
+        if self.block_store.height < block.header.height:
+            seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
+            self.block_store.save_block(block, block_parts, seen_commit)
+
+        fail.fail_point()  # crash site 2 (reference: state.go:1619)
+        if self.wal is not None:
+            self.wal.write_sync(EndHeightMessage(height), _time.time_ns())
+
+        fail.fail_point()  # crash site 3 (reference: state.go:1642)
+        state_copy = self.state.copy()
+        state_copy, retain_height = self.block_exec.apply_block(
+            state_copy,
+            BlockID(hash=block.hash(), part_set_header=block_parts.header()),
+            block,
+        )
+
+        fail.fail_point()  # crash site 4 (reference: state.go:1667)
+        if retain_height > 0:
+            try:
+                self.block_store.prune_blocks(retain_height)
+            except Exception:  # noqa: BLE001
+                pass
+
+        self.update_to_state(state_copy)
+
+        fail.fail_point()  # crash site 5 (reference: state.go:1685)
+        if self.priv_validator is not None:
+            self.priv_validator_pub_key = self.priv_validator.get_pub_key()
+        self._schedule_round_0()
+
+    # --- proposal handling --------------------------------------------------
+
+    def _set_proposal(self, proposal: Proposal) -> None:
+        """reference: consensus/state.go:1809-1850 defaultSetProposal."""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or (
+                proposal.pol_round >= 0 and proposal.pol_round >= proposal.round):
+            raise ErrInvalidProposalPOLRound()
+        proposer = rs.validators.get_proposer()
+        if not proposer.pub_key.verify_signature(
+                proposal.sign_bytes(self.state.chain_id), proposal.signature):
+            raise ErrInvalidProposalSignature()
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet.from_header(proposal.block_id.part_set_header)
+
+    def _add_proposal_block_part(self, msg: BlockPartMessage) -> bool:
+        """reference: consensus/state.go:1850-1920."""
+        rs = self.rs
+        if rs.height != msg.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        try:
+            added = rs.proposal_block_parts.add_part(msg.part)
+        except ValueError as e:
+            raise ConsensusError(str(e)) from e
+        if not added:
+            return False
+        if rs.proposal_block_parts.byte_size > self.state.consensus_params.block.max_bytes:
+            raise ConsensusError("total size of proposal block parts exceeds maximum block bytes")
+        if rs.proposal_block_parts.is_complete():
+            rs.proposal_block = Block.unmarshal(rs.proposal_block_parts.assemble())
+            self.event_bus.publish_event_complete_proposal(
+                tmevents.EventDataCompleteProposal(
+                    height=rs.height, round=rs.round, step=rs.step_name(),
+                    block_id=BlockID(hash=rs.proposal_block.hash(),
+                                     part_set_header=rs.proposal_block_parts.header()),
+                ))
+        return True
+
+    def _handle_complete_proposal(self, block_height: int) -> None:
+        """reference: consensus/state.go:1920-1945."""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(rs.round)
+        block_id, has_two_thirds = (prevotes.two_thirds_majority()
+                                    if prevotes else (None, False))
+        if has_two_thirds and not block_id.is_zero() and rs.valid_round < rs.round:
+            if rs.proposal_block.hashes_to(block_id.hash):
+                rs.valid_round = rs.round
+                rs.valid_block = rs.proposal_block
+                rs.valid_block_parts = rs.proposal_block_parts
+        if rs.step <= STEP_PROPOSE and self._is_proposal_complete():
+            self._enter_prevote(block_height, rs.round)
+            if has_two_thirds:
+                self._enter_precommit(block_height, rs.round)
+        elif rs.step == STEP_COMMIT:
+            self._try_finalize_commit(block_height)
+
+    # --- votes --------------------------------------------------------------
+
+    def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """reference: consensus/state.go:1947-1995."""
+        try:
+            return self._add_vote(vote, peer_id)
+        except ErrVoteConflictingVotes as e:
+            if self.priv_validator_pub_key is not None and (
+                    vote.validator_address == self.priv_validator_pub_key.address()):
+                raise  # conflicting vote from ourselves
+            if self.evpool is not None:
+                self.evpool.report_conflicting_votes(e.vote_a, e.vote_b)
+            return getattr(e, "added", False)
+
+    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """reference: consensus/state.go:1995-2168."""
+        rs = self.rs
+
+        # Late precommit for the previous height while in NewHeight step.
+        if vote.height + 1 == rs.height and vote.type == PRECOMMIT_TYPE:
+            if rs.step != STEP_NEW_HEIGHT:
+                return False
+            if rs.last_commit is None:
+                return False
+            added = rs.last_commit.add_vote(vote)
+            if not added:
+                return False
+            self.event_bus.publish_event_vote(tmevents.EventDataVote(vote=vote))
+            for cb in self.on_vote:
+                cb(vote)
+            if self.config.skip_timeout_commit and rs.last_commit.has_all():
+                self._enter_new_round(rs.height, 0)
+            return added
+
+        if vote.height != rs.height:
+            return False
+
+        height = rs.height
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        self.event_bus.publish_event_vote(tmevents.EventDataVote(vote=vote))
+        for cb in self.on_vote:
+            cb(vote)
+
+        if vote.type == PREVOTE_TYPE:
+            prevotes = rs.votes.prevotes(vote.round)
+            block_id, ok = prevotes.two_thirds_majority()
+            if ok:
+                # Unlock if cs.LockedRound < vote.Round <= cs.Round and the
+                # POL is for something else (reference: state.go:2060-2083).
+                if (rs.locked_block is not None
+                        and rs.locked_round < vote.round <= rs.round
+                        and not rs.locked_block.hashes_to(block_id.hash)):
+                    rs.locked_round = -1
+                    rs.locked_block = None
+                    rs.locked_block_parts = None
+                    self.event_bus.publish_event_unlock(self._round_state_event())
+                # Update Valid* (reference: state.go:2085-2113).
+                if (len(block_id.hash) != 0 and rs.valid_round < vote.round
+                        and vote.round == rs.round):
+                    if rs.proposal_block is not None and rs.proposal_block.hashes_to(block_id.hash):
+                        rs.valid_round = vote.round
+                        rs.valid_block = rs.proposal_block
+                        rs.valid_block_parts = rs.proposal_block_parts
+                    else:
+                        rs.proposal_block = None
+                    if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                            block_id.part_set_header):
+                        rs.proposal_block_parts = PartSet.from_header(block_id.part_set_header)
+                    self.event_bus.publish_event_valid_block(self._round_state_event())
+                    for cb in self.on_valid_block:
+                        cb(rs)
+            # Round transitions (reference: state.go:2115-2133).
+            if rs.round < vote.round and prevotes.has_two_thirds_any():
+                self._enter_new_round(height, vote.round)
+            elif rs.round == vote.round and STEP_PREVOTE <= rs.step:
+                block_id, ok = prevotes.two_thirds_majority()
+                if ok and (self._is_proposal_complete() or len(block_id.hash) == 0):
+                    self._enter_precommit(height, vote.round)
+                elif prevotes.has_two_thirds_any():
+                    self._enter_prevote_wait(height, vote.round)
+            elif (rs.proposal is not None and 0 <= rs.proposal.pol_round == vote.round
+                  and self._is_proposal_complete()):
+                self._enter_prevote(height, rs.round)
+
+        elif vote.type == PRECOMMIT_TYPE:
+            precommits = rs.votes.precommits(vote.round)
+            block_id, ok = precommits.two_thirds_majority()
+            if ok:
+                self._enter_new_round(height, vote.round)
+                self._enter_precommit(height, vote.round)
+                if len(block_id.hash) != 0:
+                    self._enter_commit(height, vote.round)
+                    if self.config.skip_timeout_commit and precommits.has_all():
+                        self._enter_new_round(rs.height, 0)
+                else:
+                    self._enter_precommit_wait(height, vote.round)
+            elif rs.round <= vote.round and precommits.has_two_thirds_any():
+                self._enter_new_round(height, vote.round)
+                self._enter_precommit_wait(height, vote.round)
+        return added
+
+    # --- signing ------------------------------------------------------------
+
+    def _sign_vote(self, msg_type: int, hash_: bytes, header: PartSetHeader) -> Vote | None:
+        """reference: consensus/state.go:2170-2215."""
+        if self.wal is not None:
+            self.wal.flush_and_sync()
+        if self.priv_validator_pub_key is None:
+            return None
+        addr = self.priv_validator_pub_key.address()
+        val_idx, _ = self.rs.validators.get_by_address(addr)
+        vote = Vote(
+            type=msg_type,
+            height=self.rs.height,
+            round=self.rs.round,
+            block_id=BlockID(hash=hash_, part_set_header=header),
+            timestamp=self._vote_time(),
+            validator_address=addr,
+            validator_index=val_idx,
+        )
+        self.priv_validator.sign_vote(self.state.chain_id, vote)
+        return vote
+
+    def _vote_time(self) -> Time:
+        """BFT time monotonicity (reference: consensus/state.go:2216-2234)."""
+        now = Time.now()
+        min_vote_time = now
+        time_iota_ns = self.state.consensus_params.block.time_iota_ms * 1_000_000
+        if self.rs.locked_block is not None:
+            min_vote_time = self.rs.locked_block.header.time.add_ns(time_iota_ns)
+        elif self.rs.proposal_block is not None:
+            min_vote_time = self.rs.proposal_block.header.time.add_ns(time_iota_ns)
+        return now if now > min_vote_time else min_vote_time
+
+    def _sign_add_vote(self, msg_type: int, hash_: bytes, header: PartSetHeader) -> Vote | None:
+        """reference: consensus/state.go:2236-2263."""
+        if self.priv_validator is None or self.priv_validator_pub_key is None:
+            return None
+        if not self.rs.validators.has_address(self.priv_validator_pub_key.address()):
+            return None
+        try:
+            vote = self._sign_vote(msg_type, hash_, header)
+        except Exception:  # noqa: BLE001 - double-sign guard etc: don't vote
+            return None
+        if vote is not None:
+            self._internal_queue.put(MsgInfo(VoteMessage(vote), ""))
+            if self.broadcast is not None:
+                self.broadcast(VoteMessage(vote))
+        return vote
+
+    # --- WAL catchup replay -------------------------------------------------
+
+    def _catchup_replay(self, cs_height: int) -> None:
+        """Replay WAL messages from the last height boundary (reference:
+        consensus/replay.go:93-160)."""
+        after = self.wal.search_for_end_height(cs_height - 1)
+        if after is None:
+            # no in-height messages for this height; nothing to replay
+            return
+        self.replay_mode = True
+        try:
+            for tm in after:
+                msg = wal_blob_to_msg(tm.msg) if isinstance(tm.msg, WALMessageBlob) else None
+                if msg is None:
+                    continue
+                if isinstance(msg, TimeoutInfo):
+                    self._do_handle_timeout(msg)
+                elif isinstance(msg, (ProposalMessage, BlockPartMessage, VoteMessage)):
+                    with self._mtx:
+                        self._handle_msg(MsgInfo(msg, tm.msg.peer_id))
+        finally:
+            self.replay_mode = False

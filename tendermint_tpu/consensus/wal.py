@@ -1,0 +1,202 @@
+"""Consensus write-ahead log (reference: consensus/wal.go:57,75,91,201,231,300).
+
+Frame format mirrors the reference's WALEncoder: crc32c | length | protobuf
+TimedWALMessage. Messages are replayed on restart to recover in-flight
+consensus state; EndHeightMessage marks a completed height (fsync'd, the
+crash-recovery anchor).
+
+File rotation follows libs/autofile/group.go semantics (size-limited chunks
+Head, Head.000, ...), simplified to a single directory of numbered chunks.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from dataclasses import dataclass
+
+from tendermint_tpu.encoding import proto
+
+MAX_MSG_SIZE_BYTES = 1024 * 1024  # reference: consensus/wal.go:32
+DEFAULT_HEAD_SIZE_LIMIT = 10 * 1024 * 1024
+
+
+class WALError(Exception):
+    pass
+
+
+class CorruptedWALError(WALError):
+    pass
+
+
+@dataclass
+class TimedWALMessage:
+    time_ns: int
+    msg: object  # EndHeightMessage | MsgInfo-like | TimeoutInfo-like
+
+
+@dataclass
+class EndHeightMessage:
+    height: int
+
+
+@dataclass
+class WALMessageBlob:
+    """Opaque consensus message payload: (kind, payload bytes, peer_id)."""
+
+    kind: str
+    payload: bytes
+    peer_id: str = ""
+
+
+def _encode_msg(m) -> bytes:
+    w = proto.Writer()
+    if isinstance(m, EndHeightMessage):
+        w.message(1, proto.Writer().varint(1, m.height).out(), always=True)
+    elif isinstance(m, WALMessageBlob):
+        inner = (
+            proto.Writer()
+            .string(1, m.kind)
+            .bytes(2, m.payload)
+            .string(3, m.peer_id)
+            .out()
+        )
+        w.message(2, inner, always=True)
+    else:
+        raise WALError(f"unknown WAL message type {type(m)}")
+    return w.out()
+
+
+def _decode_msg(buf: bytes):
+    f = proto.fields(buf)
+    if 1 in f:
+        inner = proto.fields(f[1][-1])
+        return EndHeightMessage(height=proto.as_sint64(inner.get(1, [0])[-1]))
+    if 2 in f:
+        inner = proto.fields(f[2][-1])
+        return WALMessageBlob(
+            kind=inner.get(1, [b""])[-1].decode(),
+            payload=inner.get(2, [b""])[-1],
+            peer_id=inner.get(3, [b""])[-1].decode() if 3 in inner else "",
+        )
+    raise CorruptedWALError("empty WAL message")
+
+
+class WAL:
+    """reference: consensus/wal.go BaseWAL."""
+
+    def __init__(self, path: str, head_size_limit: int = DEFAULT_HEAD_SIZE_LIMIT):
+        self.dir = path
+        self.head_size_limit = head_size_limit
+        os.makedirs(self.dir, exist_ok=True)
+        self._mtx = threading.Lock()
+        self._head: object | None = None
+        self._head_index = self._max_index()
+        self._open_head()
+
+    # --- chunk management (autofile group light) ---------------------------
+
+    def _chunk_path(self, index: int) -> str:
+        return os.path.join(self.dir, f"wal.{index:06d}")
+
+    def _indexes(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith("wal."):
+                try:
+                    out.append(int(name[4:]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def _max_index(self) -> int:
+        idx = self._indexes()
+        return idx[-1] if idx else 0
+
+    def _open_head(self) -> None:
+        self._head = open(self._chunk_path(self._head_index), "ab")
+
+    def _maybe_rotate(self) -> None:
+        if self._head.tell() >= self.head_size_limit:
+            self._head.close()
+            self._head_index += 1
+            self._open_head()
+
+    # --- writes ------------------------------------------------------------
+
+    def write(self, msg, time_ns: int = 0) -> None:
+        """Buffered write (fsync only on write_sync; reference:
+        consensus/wal.go:166-199)."""
+        with self._mtx:
+            self._write_locked(msg, time_ns)
+
+    def write_sync(self, msg, time_ns: int = 0) -> None:
+        with self._mtx:
+            self._write_locked(msg, time_ns)
+            self._head.flush()
+            os.fsync(self._head.fileno())
+
+    def _write_locked(self, msg, time_ns: int) -> None:
+        body = proto.Writer().varint(1, time_ns).message(2, _encode_msg(msg), always=True).out()
+        if len(body) > MAX_MSG_SIZE_BYTES:
+            raise WALError(f"msg is too big: {len(body)} bytes, max: {MAX_MSG_SIZE_BYTES} bytes")
+        crc = zlib.crc32(body) & 0xFFFFFFFF
+        self._head.write(struct.pack(">II", crc, len(body)) + body)
+        self._maybe_rotate()
+
+    def flush_and_sync(self) -> None:
+        with self._mtx:
+            self._head.flush()
+            os.fsync(self._head.fileno())
+
+    def close(self) -> None:
+        with self._mtx:
+            if self._head is not None:
+                self._head.flush()
+                self._head.close()
+                self._head = None
+
+    # --- reads -------------------------------------------------------------
+
+    def iter_messages(self, start_index: int | None = None):
+        """Yield (TimedWALMessage, (chunk_index, offset)) across chunks,
+        stopping at the first corrupt/truncated frame (crash tail)."""
+        for index in self._indexes():
+            if start_index is not None and index < start_index:
+                continue
+            path = self._chunk_path(index)
+            with open(path, "rb") as f:
+                data = f.read()
+            pos = 0
+            while pos + 8 <= len(data):
+                crc, length = struct.unpack_from(">II", data, pos)
+                if length > MAX_MSG_SIZE_BYTES:
+                    return  # corrupt tail
+                if pos + 8 + length > len(data):
+                    return  # truncated tail (crash mid-write)
+                body = data[pos + 8 : pos + 8 + length]
+                if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                    return  # corrupt tail
+                f2 = proto.fields(body)
+                time_ns = proto.as_sint64(f2.get(1, [0])[-1])
+                try:
+                    msg = _decode_msg(f2.get(2, [b""])[-1])
+                except CorruptedWALError:
+                    return
+                yield TimedWALMessage(time_ns=time_ns, msg=msg), (index, pos)
+                pos += 8 + length
+
+    def search_for_end_height(self, height: int):
+        """Find messages after EndHeightMessage{height} (reference:
+        consensus/wal.go:231-290). Returns list of messages after it, or
+        None if not found."""
+        found = False
+        after: list[TimedWALMessage] = []
+        for tm, _loc in self.iter_messages():
+            if found:
+                after.append(tm)
+            elif isinstance(tm.msg, EndHeightMessage) and tm.msg.height == height:
+                found = True
+        return after if found else None

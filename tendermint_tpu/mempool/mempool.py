@@ -1,0 +1,257 @@
+"""Mempool: v0 FIFO clist semantics + v1 priority ordering (reference:
+mempool/v0/clist_mempool.go:203,372,641, mempool/v1/mempool.go,
+mempool/cache.go).
+
+One implementation covers both reference versions behind Config.version:
+"v0" reaps in insertion order; "v1" reaps by (priority desc, insertion asc)
+using the ABCI CheckTx `priority` field. Gossip iteration (iter_txs) is
+always insertion-ordered, mirroring the clist walk the reactors do.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field as dc_field
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.types.tx import tx_key
+
+
+class MempoolError(Exception):
+    pass
+
+
+class ErrTxInCache(MempoolError):
+    def __init__(self):
+        super().__init__("tx already exists in cache")
+
+
+class ErrMempoolIsFull(MempoolError):
+    def __init__(self, n, max_n, nbytes, max_bytes):
+        super().__init__(
+            f"mempool is full: number of txs {n} (max: {max_n}), total txs bytes {nbytes} (max: {max_bytes})"
+        )
+
+
+class ErrTxTooLarge(MempoolError):
+    def __init__(self, max_size, size):
+        super().__init__(f"Tx too large. Max size is {max_size}, but got {size}")
+
+
+class ErrPreCheck(MempoolError):
+    pass
+
+
+@dataclass
+class MempoolTx:
+    tx: bytes
+    height: int  # height at which tx entered the pool
+    gas_wanted: int = 0
+    priority: int = 0
+    sender: str = ""
+    seq: int = 0
+    senders: set = dc_field(default_factory=set)  # peer ids that sent it
+
+
+class TxCache:
+    """LRU dedup cache (reference: mempool/cache.go)."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._map: OrderedDict[bytes, None] = OrderedDict()
+        self._mtx = threading.Lock()
+
+    def push(self, tx: bytes) -> bool:
+        """False if already present."""
+        k = tx_key(tx)
+        with self._mtx:
+            if k in self._map:
+                self._map.move_to_end(k)
+                return False
+            self._map[k] = None
+            if len(self._map) > self.size:
+                self._map.popitem(last=False)
+            return True
+
+    def remove(self, tx: bytes) -> None:
+        with self._mtx:
+            self._map.pop(tx_key(tx), None)
+
+    def reset(self) -> None:
+        with self._mtx:
+            self._map.clear()
+
+
+class Mempool:
+    def __init__(self, app, *, version: str = "v0", max_txs: int = 5000,
+                 max_txs_bytes: int = 1024 * 1024 * 1024,
+                 cache_size: int = 10000, max_tx_bytes: int = 1024 * 1024,
+                 keep_invalid_txs_in_cache: bool = False,
+                 recheck: bool = True):
+        self.app = app  # proxy.AppConnMempool-like
+        self.version = version
+        self.max_txs = max_txs
+        self.max_txs_bytes = max_txs_bytes
+        self.max_tx_bytes = max_tx_bytes
+        self.keep_invalid = keep_invalid_txs_in_cache
+        self.recheck = recheck
+
+        self.cache = TxCache(cache_size)
+        self._txs: OrderedDict[bytes, MempoolTx] = OrderedDict()  # key -> tx
+        self._txs_bytes = 0
+        self._height = 0
+        self._seq = 0
+        self._mtx = threading.RLock()
+        self._notified_available = False
+        self._txs_available: threading.Event | None = None
+        self.pre_check = None   # fn(tx) -> raises ErrPreCheck
+        self.post_check = None  # fn(tx, res) -> raises
+
+    # --- Mempool interface (reference: mempool/mempool.go:14-90) -----------
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._txs)
+
+    def size_bytes(self) -> int:
+        with self._mtx:
+            return self._txs_bytes
+
+    def lock(self) -> None:
+        self._mtx.acquire()
+
+    def unlock(self) -> None:
+        self._mtx.release()
+
+    def enable_txs_available(self) -> None:
+        self._txs_available = threading.Event()
+
+    def txs_available(self) -> threading.Event | None:
+        return self._txs_available
+
+    def check_tx(self, tx: bytes, sender_peer: str = "") -> abci.ResponseCheckTx:
+        """Synchronous CheckTx (reference: mempool/v0/clist_mempool.go:203)."""
+        if len(tx) > self.max_tx_bytes:
+            raise ErrTxTooLarge(self.max_tx_bytes, len(tx))
+        if self.pre_check is not None:
+            self.pre_check(tx)
+        with self._mtx:
+            if len(self._txs) >= self.max_txs or self._txs_bytes + len(tx) > self.max_txs_bytes:
+                raise ErrMempoolIsFull(len(self._txs), self.max_txs,
+                                       self._txs_bytes, self.max_txs_bytes)
+        if not self.cache.push(tx):
+            # record extra sender for gossip suppression
+            with self._mtx:
+                existing = self._txs.get(tx_key(tx))
+                if existing is not None and sender_peer:
+                    existing.senders.add(sender_peer)
+            raise ErrTxInCache()
+
+        res = self.app.check_tx(abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW))
+        if self.post_check is not None:
+            self.post_check(tx, res)
+        if res.is_ok():
+            with self._mtx:
+                self._seq += 1
+                mtx = MempoolTx(tx=tx, height=self._height,
+                                gas_wanted=res.gas_wanted, priority=res.priority,
+                                sender=res.sender, seq=self._seq)
+                if sender_peer:
+                    mtx.senders.add(sender_peer)
+                self._txs[tx_key(tx)] = mtx
+                self._txs_bytes += len(tx)
+                self._notify_txs_available()
+        else:
+            if not self.keep_invalid:
+                self.cache.remove(tx)
+        return res
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
+        """reference: mempool/v0/clist_mempool.go:519-555; v1 orders by
+        priority."""
+        from tendermint_tpu.encoding.proto import encode_uvarint
+
+        with self._mtx:
+            entries = list(self._txs.values())
+            if self.version == "v1":
+                entries.sort(key=lambda m: (-m.priority, m.seq))
+            out = []
+            total_bytes = 0
+            total_gas = 0
+            for m in entries:
+                aux = len(m.tx) + len(encode_uvarint(len(m.tx))) + 1
+                if max_bytes > -1 and total_bytes + aux > max_bytes:
+                    break
+                if max_gas > -1 and total_gas + m.gas_wanted > max_gas:
+                    break
+                total_bytes += aux
+                total_gas += m.gas_wanted
+                out.append(m.tx)
+            return out
+
+    def reap_max_txs(self, n: int) -> list[bytes]:
+        with self._mtx:
+            entries = list(self._txs.values())
+            if self.version == "v1":
+                entries.sort(key=lambda m: (-m.priority, m.seq))
+            if n < 0:
+                n = len(entries)
+            return [m.tx for m in entries[:n]]
+
+    def update(self, height: int, txs: list[bytes],
+               deliver_tx_responses: list[abci.ResponseDeliverTx] | None = None) -> None:
+        """Remove committed txs; recheck the rest (reference:
+        mempool/v0/clist_mempool.go:577-639). Caller must hold the lock."""
+        self._height = height
+        self._notified_available = False
+        for i, tx in enumerate(txs):
+            ok = deliver_tx_responses is None or deliver_tx_responses[i].is_ok()
+            if ok:
+                self.cache.push(tx)  # committed: keep in cache to reject re-adds
+            elif not self.keep_invalid:
+                self.cache.remove(tx)
+            k = tx_key(tx)
+            m = self._txs.pop(k, None)
+            if m is not None:
+                self._txs_bytes -= len(m.tx)
+        if self.recheck and self._txs:
+            self._recheck_txs()
+        if self._txs:
+            self._notify_txs_available()
+
+    def _recheck_txs(self) -> None:
+        """reference: mempool/v0/clist_mempool.go:641-664."""
+        for k in list(self._txs.keys()):
+            m = self._txs[k]
+            res = self.app.check_tx(
+                abci.RequestCheckTx(tx=m.tx, type=abci.CHECK_TX_TYPE_RECHECK)
+            )
+            if not res.is_ok():
+                del self._txs[k]
+                self._txs_bytes -= len(m.tx)
+                if not self.keep_invalid:
+                    self.cache.remove(m.tx)
+
+    def flush(self) -> None:
+        with self._mtx:
+            self._txs.clear()
+            self._txs_bytes = 0
+            self.cache.reset()
+
+    def remove_tx_by_key(self, key: bytes) -> None:
+        with self._mtx:
+            m = self._txs.pop(key, None)
+            if m is not None:
+                self._txs_bytes -= len(m.tx)
+                self.cache.remove(m.tx)
+
+    def iter_txs(self) -> list[MempoolTx]:
+        """Insertion-ordered snapshot for gossip (the clist walk)."""
+        with self._mtx:
+            return list(self._txs.values())
+
+    def _notify_txs_available(self) -> None:
+        if self._txs_available is not None and not self._notified_available:
+            self._notified_available = True
+            self._txs_available.set()

@@ -50,17 +50,9 @@ MIN_BUCKET = 64
 # coords, built once on host with exact ints.
 def _build_base_table() -> np.ndarray:
     pts = [(0, 1)]  # affine (x, y); identity is (0, 1)
-    bx, by = ref.BASE[0], ref.BASE[1]
-
-    def aff_add(p, q):
-        x1, y1 = p
-        x2, y2 = q
-        x3 = (x1 * y2 + x2 * y1) * pow(1 + ed.D * x1 * x2 * y1 * y2, P - 2, P) % P
-        y3 = (y1 * y2 + x1 * x2) * pow(1 - ed.D * x1 * x2 * y1 * y2, P - 2, P) % P
-        return (x3, y3)
-
+    base = (ref.BASE[0], ref.BASE[1])
     for _ in range(15):
-        pts.append(aff_add(pts[-1], (bx, by)))
+        pts.append(ed.affine_add(pts[-1], base))
     return np.stack([ed.from_affine(x, y) for (x, y) in pts])  # (16, 4, 20)
 
 
@@ -120,13 +112,8 @@ def _verify_kernel(a_neg, h_win, s_win, r_y, r_sign, valid, axis_name=None):
     return ok & valid
 
 
-_kernel_cache: dict[int, object] = {}
-
-
-def _kernel_for(n: int):
-    if n not in _kernel_cache:
-        _kernel_cache[n] = jax.jit(_verify_kernel)
-    return _kernel_cache[n]
+# jax.jit caches one executable per input shape (= per padded bucket size).
+_jnp_kernel = jax.jit(_verify_kernel)
 
 
 # ---------------------------------------------------------------------------
@@ -221,11 +208,33 @@ def prepare(items: list[tuple[bytes, bytes, bytes]]):
     ), n
 
 
+def _use_pallas() -> bool:
+    import os
+
+    mode = os.environ.get("TM_TPU_ED25519_KERNEL", "auto")
+    if mode == "pallas":
+        return True
+    if mode == "jnp":
+        return False
+    # Pallas TPU lowering only; "axon" is this image's TPU plugin name.
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
-    """Batched verify of [(pub, msg, sig)]; returns (len(items),) bool."""
+    """Batched verify of [(pub, msg, sig)]; returns (len(items),) bool.
+
+    Dispatches to the fused Pallas kernel on TPU (ops/ed25519_pallas); the
+    pure-jnp path remains as the CPU / fallback implementation."""
     if not items:
         return np.zeros((0,), dtype=bool)
     args, n = prepare(items)
-    kern = _kernel_for(args["a_neg"].shape[0])
-    ok = kern(**{k: jnp.asarray(v) for k, v in args.items()})
+    if _use_pallas():
+        from tendermint_tpu.ops import ed25519_pallas
+
+        targs = ed25519_pallas.transpose_args(args)
+        ok = ed25519_pallas.verify_kernel_pallas(
+            **{k: jnp.asarray(v) for k, v in targs.items()}
+        )
+        return np.asarray(ok)[0, :n].astype(bool)
+    ok = _jnp_kernel(**{k: jnp.asarray(v) for k, v in args.items()})
     return np.asarray(ok)[:n]

@@ -1,0 +1,379 @@
+"""Pallas TPU kernel for batched ed25519 verification.
+
+Same math as ops/ed25519_batch (shared-window Straus, complete Edwards
+addition, canonical-encoding compare) but fused into ONE TPU kernel so the
+point state never leaves VMEM. Two layout changes vs the jnp path:
+
+ * batch on the LANE axis: field elements are (20, T) int32 tiles (limb rows
+   x T signatures), so every field op is a full-width VPU op. The jnp path's
+   (N, 20) layout wastes 108 of 128 lanes.
+ * vectorized carries: instead of a 20-step sequential carry chain, each pass
+   computes all carries at once and shifts them down one limb row (with the
+   2^260 === 608 fold wrapping row 19 -> row 0). Pass counts per op are fixed
+   by worst-case bound analysis (see _carry_n).
+
+Bound discipline matches ops/field25519: all stored limbs < 9500, products
+and 20-term accumulations stay below 2^31 in int32.
+
+The per-signature window table for -A (16 points) is built in a VMEM scratch;
+the fixed-base table for B is baked into the kernel as "niels"-form constants
+(y+x, y-x, 2dxy), making the B addition a 7-mul mixed add.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tendermint_tpu.crypto import ed25519 as ref
+from tendermint_tpu.ops import edwards25519 as ed
+from tendermint_tpu.ops import field25519 as fe
+
+MASK = fe.MASK
+FOLD = fe.FOLD
+NLIMB = fe.NLIMB
+P = fe.P
+TILE = 256  # lanes per grid step (multiple of 128)
+
+_PSUB = np.asarray(fe.PSUB_LIMBS, dtype=np.int32).reshape(NLIMB, 1)
+_P_CANON = np.asarray(fe.P_LIMBS, dtype=np.int32).reshape(NLIMB, 1)
+_TWO_D = np.asarray(fe.from_int(2 * ed.D % P), dtype=np.int32).reshape(NLIMB, 1)
+
+# Fixed-base niels table: TAB_B_NIELS[w] = (y+x, y-x, 2dxy) of w*B, w=0..15.
+def _build_b_niels() -> np.ndarray:
+    out = np.zeros((16, 3, NLIMB), dtype=np.int32)
+    x, y = 0, 1  # identity
+    base = (ref.BASE[0], ref.BASE[1])
+    for w in range(16):
+        out[w, 0] = fe.from_int((y + x) % P)
+        out[w, 1] = fe.from_int((y - x) % P)
+        out[w, 2] = fe.from_int(2 * ed.D * x * y % P)
+        x, y = ed.affine_add((x, y), base)
+    return out
+
+
+_TAB_B = _build_b_niels()
+
+# Pallas kernels may not capture array constants; everything per-lane-uniform
+# is packed into one (1020, 1) int32 input: rows 0-19 = 32p limbs, 20-39 =
+# canonical p limbs, 40-59 = 2d limbs, 60-1019 = the 16x3x20 B niels table.
+CONSTS = np.concatenate(
+    [_PSUB, _P_CANON, _TWO_D, _TAB_B.reshape(960, 1)], axis=0
+).astype(np.int32)
+
+# Trace-time context: set at kernel entry to slices of the consts ref so the
+# field helpers below can use them without captures.
+_CTX: dict = {}
+
+
+# --- field ops on (20, T) int32 values --------------------------------------
+
+
+def _carry_n(e, n: int):
+    """n vectorized carry passes. Each pass: split rows into low 13 bits +
+    carries, shift carries down one row, fold row-19 carry into row 0 by 608.
+
+    Pass counts (worst-case bound analysis, mirrors ops/field25519 docstring):
+      mul output (<= 1.94e9): 4 passes -> rows <= 8799
+      sub output (<= 25881):  2 passes -> rows <= 8799
+      2x  output (<= 17598):  1 pass   -> rows <= 9407
+      add output (<= 19000):  1 pass   -> rows <= 9407
+    """
+    for _ in range(n):
+        c = e >> 13
+        e = e & MASK
+        e = e + jnp.concatenate([c[19:20] * FOLD, c[:19]], axis=0)
+    return e
+
+
+def _mul(a, b):
+    """(20,T) x (20,T) -> (20,T), inputs NORM (<9500), output <= 8799.
+
+    Shift-accumulate via concatenation (Pallas TPU lowering has no scatter;
+    static concats lower cleanly)."""
+    t = a.shape[1]
+    zrow = jnp.zeros((1, t), dtype=jnp.int32)
+    conv = None
+    for i in range(NLIMB):
+        prod = a[i : i + 1] * b  # (20, T)
+        shifted = jnp.concatenate(
+            [zrow] * i + [prod] + [zrow] * (NLIMB - 1 - i), axis=0
+        )  # (39, T)
+        conv = shifted if conv is None else conv + shifted
+    c = conv[:NLIMB]
+    d = conv[NLIMB:]
+    lo = d & MASK
+    hi = d >> 13
+    c = c + jnp.concatenate([FOLD * lo, zrow], axis=0)
+    c = c + jnp.concatenate([zrow, FOLD * hi], axis=0)
+    return _carry_n(c, 4)
+
+
+def _sq(a):
+    return _mul(a, a)
+
+
+def _add(a, b):
+    return _carry_n(a + b, 1)
+
+
+def _sub(a, b):
+    """a + 64p(fat limbs, every limb >= 9500) - b: limb-wise non-negative."""
+    return _carry_n(a + _CTX["psub"] - b, 2)
+
+
+def _dbl_limb(a):
+    return _carry_n(a * 2, 1)
+
+
+# --- point ops: points are (X, Y, Z, T) tuples of (20, T) -------------------
+
+
+def _pt_double(p):
+    X, Y, Z, _ = p
+    a = _sq(X)
+    b = _sq(Y)
+    c = _dbl_limb(_sq(Z))
+    h = _add(a, b)
+    e = _sub(h, _sq(_add(X, Y)))
+    g = _sub(a, b)
+    f = _add(c, g)
+    return (_mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h))
+
+
+def _pt_add(p, q):
+    """Complete extended addition (both operands full points)."""
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    a = _mul(_sub(Y1, X1), _sub(Y2, X2))
+    b = _mul(_add(Y1, X1), _add(Y2, X2))
+    c = _mul(_mul(T1, T2), _CTX["two_d"])
+    d = _dbl_limb(_mul(Z1, Z2))
+    e = _sub(b, a)
+    f = _sub(d, c)
+    g = _add(d, c)
+    h = _add(b, a)
+    return (_mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h))
+
+
+def _pt_madd_niels(p, ypx, ymx, txy2d):
+    """Mixed add with a niels-form affine point (y+x, y-x, 2dxy): 7 muls."""
+    X1, Y1, Z1, T1 = p
+    a = _mul(_sub(Y1, X1), ymx)
+    b = _mul(_add(Y1, X1), ypx)
+    c = _mul(T1, txy2d)
+    d = _dbl_limb(Z1)
+    e = _sub(b, a)
+    f = _sub(d, c)
+    g = _add(d, c)
+    h = _add(b, a)
+    return (_mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h))
+
+
+def _select16(w, table_rows):
+    """Per-lane 16-way select. w: (1, T) window index; table_rows: list of 16
+    (rows, T) arrays. Returns sum_k (w==k) * table_rows[k]."""
+    out = None
+    for k in range(16):
+        m = (w == k).astype(jnp.int32)
+        term = m * table_rows[k]
+        out = term if out is None else out + term
+    return out
+
+
+def _inv(a):
+    z2 = _sq(a)
+    z9 = _mul(a, _sq(_sq(z2)))
+    z11 = _mul(z2, z9)
+    z_5_0 = _mul(z9, _sq(z11))
+    t = z_5_0
+    for _ in range(5):
+        t = _sq(t)
+    z_10_0 = _mul(t, z_5_0)
+    t = z_10_0
+    for _ in range(10):
+        t = _sq(t)
+    z_20_0 = _mul(t, z_10_0)
+    t = z_20_0
+    for _ in range(20):
+        t = _sq(t)
+    z_40_0 = _mul(t, z_20_0)
+    t = z_40_0
+    for _ in range(10):
+        t = _sq(t)
+    z_50_0 = _mul(t, z_10_0)
+    t = z_50_0
+    for _ in range(50):
+        t = _sq(t)
+    z_100_0 = _mul(t, z_50_0)
+    t = z_100_0
+    for _ in range(100):
+        t = _sq(t)
+    z_200_0 = _mul(t, z_100_0)
+    t = z_200_0
+    for _ in range(50):
+        t = _sq(t)
+    z_250_0 = _mul(t, z_50_0)
+    t = z_250_0
+    for _ in range(5):
+        t = _sq(t)
+    return _mul(t, z11)
+
+
+def _to_canonical(a):
+    for _ in range(2):
+        top = a[19:20]
+        a = jnp.concatenate([a[0:1] + (top >> 8) * 19, a[1:19], top & 0xFF], axis=0)
+        a = _carry_n(a, 2)
+    p_limbs = _CTX["p_canon"]
+    for _ in range(2):
+        # a - p with borrow propagation (sequential over 20 rows)
+        rows = []
+        borrow = jnp.zeros_like(a[0:1])
+        for k in range(NLIMB):
+            v = a[k : k + 1] - p_limbs[k : k + 1] - borrow
+            borrow = (v < 0).astype(jnp.int32)
+            rows.append(v + borrow * (MASK + 1))
+        diff = jnp.concatenate(rows, axis=0)
+        a = jnp.where(borrow == 0, diff, a)
+    return a
+
+
+# --- the kernel --------------------------------------------------------------
+
+
+def _kernel(consts_ref, a_neg_ref, h_win_ref, s_win_ref, r_y_ref, r_sv_ref, ok_ref, tab_ref):
+    t = TILE
+    _CTX["psub"] = consts_ref[0:20, :]
+    _CTX["p_canon"] = consts_ref[20:40, :]
+    _CTX["two_d"] = consts_ref[40:60, :]
+
+    def pt_read(rows_ref, base):
+        return (
+            rows_ref[base : base + 20, :],
+            rows_ref[base + 20 : base + 40, :],
+            rows_ref[base + 40 : base + 60, :],
+            rows_ref[base + 60 : base + 80, :],
+        )
+
+    def pt_write(rows_ref, base, p):
+        rows_ref[base : base + 20, :] = p[0]
+        rows_ref[base + 20 : base + 40, :] = p[1]
+        rows_ref[base + 40 : base + 60, :] = p[2]
+        rows_ref[base + 60 : base + 80, :] = p[3]
+
+    zero = jnp.zeros((20, t), dtype=jnp.int32)
+    one = jnp.concatenate(
+        [jnp.ones((1, t), dtype=jnp.int32), jnp.zeros((19, t), dtype=jnp.int32)], axis=0
+    )
+    identity = (zero, one, one, zero)
+
+    # Build the per-sig window table for -A in VMEM scratch: tab[w] = w*(-A).
+    pt_write(tab_ref, 0, identity)
+    a_neg = pt_read(a_neg_ref, 0)
+    pt_write(tab_ref, 80, a_neg)
+    for w in range(2, 16):
+        if w % 2 == 0:
+            src = pt_read(tab_ref, (w // 2) * 80)
+            pt_write(tab_ref, w * 80, _pt_double(src))
+        else:
+            src = pt_read(tab_ref, (w - 1) * 80)
+            pt_write(tab_ref, w * 80, _pt_add(src, a_neg))
+
+    def tab_b(k: int, f: int):
+        base = 60 + (k * 3 + f) * 20
+        return consts_ref[base : base + 20, :]  # (20, 1)
+
+    def body(j, acc):
+        acc = _pt_double(_pt_double(_pt_double(_pt_double(acc))))
+        wh = h_win_ref[pl.ds(j, 1), :]  # (1, T)
+        ws = s_win_ref[pl.ds(j, 1), :]
+        # gather w*(-A) from scratch (16-way select over the whole point)
+        rows = [tab_ref[k * 80 : k * 80 + 80, :] for k in range(16)]
+        pa = _select16(wh, rows)
+        acc = _pt_add(acc, (pa[0:20], pa[20:40], pa[40:60], pa[60:80]))
+        # gather w*B from niels constants ((20,1) broadcast over lanes)
+        ypx = _select16(ws, [tab_b(k, 0) for k in range(16)])
+        ymx = _select16(ws, [tab_b(k, 1) for k in range(16)])
+        txy = _select16(ws, [tab_b(k, 2) for k in range(16)])
+        acc = _pt_madd_niels(acc, ypx, ymx, txy)
+        return acc
+
+    acc = jax.lax.fori_loop(0, 64, body, identity)
+
+    zinv = _inv(acc[2])
+    x = _to_canonical(_mul(acc[0], zinv))
+    y = _to_canonical(_mul(acc[1], zinv))
+    sign = x[0:1] & 1
+
+    r_y = r_y_ref[:, :]
+    r_sign = r_sv_ref[0:1, :]
+    valid = r_sv_ref[1:2, :]
+    y_eq = jnp.all(y == r_y, axis=0, keepdims=True)
+    ok = y_eq & (sign == r_sign) & (valid != 0)
+    ok_ref[:, :] = ok.astype(jnp.int32)
+
+
+def _pallas_verify(a_neg, h_win, s_win, r_y, r_sv, *, interpret=False):
+    """a_neg (80,N), h_win (64,N), s_win (64,N), r_y (20,N), r_sv (2,N)
+    -> ok (1, N) int32. N must be a multiple of TILE."""
+    n = a_neg.shape[1]
+    grid = (n // TILE,)
+
+    def spec(rows):
+        return pl.BlockSpec((rows, TILE), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+    consts_spec = pl.BlockSpec(
+        (CONSTS.shape[0], 1), lambda i: (0, 0), memory_space=pltpu.VMEM
+    )
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
+        grid=grid,
+        in_specs=[consts_spec, spec(80), spec(64), spec(64), spec(20), spec(2)],
+        out_specs=spec(1),
+        scratch_shapes=[pltpu.VMEM((16 * 80, TILE), jnp.int32)],
+        interpret=interpret,
+    )(jnp.asarray(CONSTS), a_neg, h_win, s_win, r_y, r_sv)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def verify_kernel_pallas(a_neg, h_win, s_win, r_y, r_sv, interpret=False):
+    return _pallas_verify(a_neg, h_win, s_win, r_y, r_sv, interpret=interpret)
+
+
+def transpose_args(args: dict) -> dict:
+    """Convert the (N, ...) prepare() layout into the lane-major kernel layout,
+    padding N up to a TILE multiple."""
+    n = args["a_neg"].shape[0]
+    nb = ((n + TILE - 1) // TILE) * TILE
+    pad = nb - n
+
+    a_neg = args["a_neg"].reshape(n, 80).T  # (80, N)
+    h_win = args["h_win"].T
+    s_win = args["s_win"].T
+    r_y = args["r_y"].T
+    r_sv = np.stack([args["r_sign"], args["valid"].astype(np.int32)])
+
+    def padlane(x):
+        return np.pad(x, ((0, 0), (0, pad))) if pad else x
+
+    # padded lanes: a_neg rows must still be a valid point -> identity
+    a_neg = padlane(a_neg)
+    if pad:
+        ident = np.concatenate(
+            [fe.from_int(0), fe.from_int(1), fe.from_int(1), fe.from_int(0)]
+        ).reshape(80, 1)
+        a_neg[:, n:] = ident
+    return dict(
+        a_neg=np.ascontiguousarray(a_neg),
+        h_win=np.ascontiguousarray(padlane(h_win)),
+        s_win=np.ascontiguousarray(padlane(s_win)),
+        r_y=np.ascontiguousarray(padlane(r_y)),
+        r_sv=np.ascontiguousarray(padlane(r_sv)),
+    )

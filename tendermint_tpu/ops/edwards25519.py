@@ -31,6 +31,17 @@ IDENTITY_LIMBS = np.stack(
 )  # (4, 20)
 
 
+def affine_add(p: tuple[int, int], q: tuple[int, int]) -> tuple[int, int]:
+    """Host-side exact affine Edwards addition (complete formula) for
+    building precomputed tables; (0, 1) is the identity."""
+    x1, y1 = p
+    x2, y2 = q
+    den = D * x1 * x2 * y1 * y2 % P
+    x3 = (x1 * y2 + x2 * y1) * pow(1 + den, P - 2, P) % P
+    y3 = (y1 * y2 + x1 * x2) * pow(1 - den, P - 2, P) % P
+    return (x3, y3)
+
+
 def identity(shape=()) -> jnp.ndarray:
     return jnp.broadcast_to(
         jnp.asarray(IDENTITY_LIMBS), tuple(shape) + (4, 20)

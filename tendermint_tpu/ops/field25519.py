@@ -14,7 +14,7 @@ Bound discipline (the invariant every stored element satisfies):
 
 * mul(a, b) requires NORM inputs, returns limbs <= 8799.
 * add(a, b) requires NORM inputs, returns limbs <= 9409.
-* sub(a, b) requires NORM inputs, returns limbs <= 8799.
+* sub(a, b) requires NORM inputs, returns limbs <= 9409.
 
 Bound proof for mul with M = 9500: products <= M^2 = 9.03e7; low-convolution
 c_k sums <= 20 terms -> 1.81e9; the high half d_k (<= 19 M^2) is split
@@ -44,10 +44,30 @@ MASK = (1 << RADIX) - 1  # 8191
 FOLD = 608
 
 _P_CANON = [(P >> (RADIX * i)) & MASK for i in range(NLIMB)]
-# 32*p with every limb scaled by 32: limb-wise a + 32P - b never goes negative
-# for NORM b (min fat limb = 32*511 = 16352 > 9500).
-P32_LIMBS = np.array([32 * l for l in _P_CANON], dtype=np.int32)
 P_LIMBS = np.array(_P_CANON, dtype=np.int32)
+
+
+def _fat_multiple_of_p() -> np.ndarray:
+    """Limb vector m with sum(m_i 2^13i) == 64*p and EVERY limb >= 9500, so
+    a + m - b is limb-wise non-negative for any NORM b (limbs < 9500).
+
+    Built by borrow-redistribution: start from the unconstrained radix-2^13
+    split of 64p (top limb 2^14-1 = 16383 since 64p = 2^261 - 1216), then for
+    any limb below 9500 add 2^13 and borrow 1 from the limb above."""
+    v = 64 * P
+    m = [(v >> (RADIX * i)) & MASK for i in range(NLIMB - 1)]
+    m.append(v >> (RADIX * (NLIMB - 1)))  # unmasked top: 16383
+    for i in range(NLIMB - 1):
+        while m[i] < 9500:
+            m[i] += MASK + 1
+            m[i + 1] -= 1
+    assert all(x >= 9500 for x in m) and m[NLIMB - 1] < (1 << 15)
+    assert sum(x << (RADIX * i) for i, x in enumerate(m)) == 64 * P
+    return np.array(m, dtype=np.int32)
+
+
+# Fat-limb multiple of p for limb-wise subtraction without negatives.
+PSUB_LIMBS = _fat_multiple_of_p()
 
 
 def from_int(x: int) -> np.ndarray:
@@ -94,10 +114,10 @@ def add(a, b):
 
 
 def sub(a, b):
-    """a - b mod p via a + 32p - b with fat limbs (never negative).
-    Max pre-carry limb ~ 2^18.1; one pass leaves limb0 <= 8191 + 33*608 over
-    -> needs the extra limb-0 step inside _carry_once; result <= 9409."""
-    m = jnp.asarray(P32_LIMBS)
+    """a - b mod p via a + 64p(fat limbs) - b: limb-wise non-negative for
+    NORM b. Max pre-carry limb < 9500 + 16384 < 2^14.7; one pass (with its
+    limb-0 fold step) leaves limbs <= 9409."""
+    m = jnp.asarray(PSUB_LIMBS)
     return _carry_once(a + m - b)
 
 

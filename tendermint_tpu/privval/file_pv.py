@@ -1,0 +1,284 @@
+"""FilePV: file-backed private validator with double-sign protection
+(reference: privval/file.go:75,92,300-341).
+
+Split into a key file (immutable) and a last-sign-state file (fsync'd before
+every signature release) exactly like the reference, so a crash between sign
+and broadcast can never produce conflicting signatures on restart.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import tempfile
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto import ed25519, keys
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE, PROPOSAL_TYPE, Vote
+
+STEP_NONE = 0
+STEP_PROPOSE = 1
+STEP_PREVOTE = 2
+STEP_PRECOMMIT = 3
+
+
+def vote_to_step(vote: Vote) -> int:
+    if vote.type == PREVOTE_TYPE:
+        return STEP_PREVOTE
+    if vote.type == PRECOMMIT_TYPE:
+        return STEP_PRECOMMIT
+    raise ValueError(f"Unknown vote type: {vote.type}")
+
+
+class DoubleSignError(Exception):
+    pass
+
+
+@dataclass
+class FilePVLastSignState:
+    """reference: privval/file.go:75-130."""
+
+    height: int = 0
+    round: int = 0
+    step: int = 0
+    signature: bytes = b""
+    sign_bytes: bytes = b""
+    file_path: str = ""
+
+    def check_hrs(self, height: int, round_: int, step: int) -> bool:
+        """Returns True if we have signed EXACTLY this HRS before (caller may
+        re-sign iff sign-bytes match modulo timestamp). Raises on regression
+        (reference: privval/file.go:92-130)."""
+        if self.height > height:
+            raise DoubleSignError(f"height regression. Got {height}, last height {self.height}")
+        if self.height == height:
+            if self.round > round_:
+                raise DoubleSignError(
+                    f"round regression at height {height}. Got {round_}, last round {self.round}"
+                )
+            if self.round == round_:
+                if self.step > step:
+                    raise DoubleSignError(
+                        f"step regression at height {height} round {round_}. "
+                        f"Got {step}, last step {self.step}"
+                    )
+                if self.step == step:
+                    if not self.sign_bytes:
+                        raise DoubleSignError("no SignBytes found")
+                    if not self.signature:
+                        raise AssertionError("pv: Signature is nil but SignBytes is not!")
+                    return True
+        return False
+
+    def save(self) -> None:
+        """Atomic write + fsync (the double-sign guard depends on this)."""
+        doc = {
+            "height": str(self.height),
+            "round": self.round,
+            "step": self.step,
+            "signature": base64.b64encode(self.signature).decode() if self.signature else None,
+            "signbytes": self.sign_bytes.hex().upper() if self.sign_bytes else None,
+        }
+        _atomic_write_json(self.file_path, doc)
+
+    @staticmethod
+    def load(path: str) -> "FilePVLastSignState":
+        with open(path) as f:
+            doc = json.load(f)
+        return FilePVLastSignState(
+            height=int(doc.get("height", 0)),
+            round=int(doc.get("round", 0)),
+            step=int(doc.get("step", 0)),
+            signature=base64.b64decode(doc["signature"]) if doc.get("signature") else b"",
+            sign_bytes=bytes.fromhex(doc["signbytes"]) if doc.get("signbytes") else b"",
+            file_path=path,
+        )
+
+
+class FilePV:
+    """reference: privval/file.go:132-341."""
+
+    def __init__(self, priv_key: keys.PrivKey, key_file_path: str, state_file_path: str):
+        self.priv_key = priv_key
+        self.key_file_path = key_file_path
+        self.last_sign_state = FilePVLastSignState(file_path=state_file_path)
+
+    # --- construction ------------------------------------------------------
+
+    @staticmethod
+    def generate(key_file_path: str, state_file_path: str, seed: bytes | None = None) -> "FilePV":
+        pv = FilePV(ed25519.gen_priv_key(seed), key_file_path, state_file_path)
+        pv.save()
+        return pv
+
+    @staticmethod
+    def load(key_file_path: str, state_file_path: str) -> "FilePV":
+        with open(key_file_path) as f:
+            doc = json.load(f)
+        kt = doc["priv_key"]["type"]
+        kb = base64.b64decode(doc["priv_key"]["value"])
+        name = {"tendermint/PrivKeyEd25519": "ed25519"}.get(kt, kt)
+        priv = keys.privkey_from_type_bytes(name, kb)
+        pv = FilePV(priv, key_file_path, state_file_path)
+        if os.path.exists(state_file_path) and os.path.getsize(state_file_path) > 0:
+            pv.last_sign_state = FilePVLastSignState.load(state_file_path)
+        else:
+            pv.last_sign_state.save()
+        return pv
+
+    @staticmethod
+    def load_or_generate(key_file_path: str, state_file_path: str) -> "FilePV":
+        if os.path.exists(key_file_path):
+            return FilePV.load(key_file_path, state_file_path)
+        return FilePV.generate(key_file_path, state_file_path)
+
+    def save(self) -> None:
+        pub = self.priv_key.pub_key()
+        doc = {
+            "address": pub.address().hex().upper(),
+            "pub_key": {
+                "type": "tendermint/PubKeyEd25519",
+                "value": base64.b64encode(pub.bytes()).decode(),
+            },
+            "priv_key": {
+                "type": "tendermint/PrivKeyEd25519",
+                "value": base64.b64encode(self.priv_key.bytes()).decode(),
+            },
+        }
+        _atomic_write_json(self.key_file_path, doc)
+        self.last_sign_state.save()
+
+    # --- PrivValidator interface (reference: types/priv_validator.go) ------
+
+    def get_pub_key(self) -> keys.PubKey:
+        return self.priv_key.pub_key()
+
+    def get_address(self) -> bytes:
+        return self.priv_key.pub_key().address()
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        """Sets vote.signature (and possibly reuses timestamp); raises on
+        double-sign (reference: privval/file.go:300-341 signVote)."""
+        height, round_, step = vote.height, vote.round, vote_to_step(vote)
+        lss = self.last_sign_state
+        same_hrs = lss.check_hrs(height, round_, step)
+        sign_bytes = vote.sign_bytes(chain_id)
+
+        if same_hrs:
+            if sign_bytes == lss.sign_bytes:
+                vote.signature = lss.signature
+                return
+            ts = _extract_vote_timestamp(lss.sign_bytes, chain_id, vote)
+            if ts is not None:
+                # Same vote modulo timestamp: re-sign with the PREVIOUS
+                # timestamp (reference behavior).
+                vote.timestamp = ts
+                vote.signature = lss.signature
+                return
+            raise DoubleSignError("conflicting data")
+
+        sig = self.priv_key.sign(sign_bytes)
+        self._save_signed(height, round_, step, sign_bytes, sig)
+        vote.signature = sig
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        """reference: privval/file.go:343-391."""
+        height, round_, step = proposal.height, proposal.round, STEP_PROPOSE
+        lss = self.last_sign_state
+        same_hrs = lss.check_hrs(height, round_, step)
+        sign_bytes = proposal.sign_bytes(chain_id)
+
+        if same_hrs:
+            if sign_bytes == lss.sign_bytes:
+                proposal.signature = lss.signature
+                return
+            ts = _extract_proposal_timestamp(lss.sign_bytes, chain_id, proposal)
+            if ts is not None:
+                proposal.timestamp = ts
+                proposal.signature = lss.signature
+                return
+            raise DoubleSignError("conflicting data")
+
+        sig = self.priv_key.sign(sign_bytes)
+        self._save_signed(height, round_, step, sign_bytes, sig)
+        proposal.signature = sig
+
+    def _save_signed(self, height: int, round_: int, step: int,
+                     sign_bytes: bytes, sig: bytes) -> None:
+        lss = self.last_sign_state
+        lss.height, lss.round, lss.step = height, round_, step
+        lss.signature, lss.sign_bytes = sig, sign_bytes
+        lss.save()
+
+
+def _extract_vote_timestamp(last_sign_bytes: bytes, chain_id: str, vote: Vote) -> Time | None:
+    """If last_sign_bytes equals vote's sign-bytes modulo timestamp, return
+    the last timestamp (reference: privval/utils checkVotesOnlyDifferByTimestamp)."""
+    from tendermint_tpu.encoding import proto as p
+    from tendermint_tpu.types.vote import canonical_vote_bytes
+
+    try:
+        body, _ = p.parse_delimited(last_sign_bytes)
+        f = p.fields(body)
+        ts = Time.unmarshal(f.get(5, [b""])[-1])
+    except Exception:  # noqa: BLE001
+        return None
+    trial = canonical_vote_bytes(chain_id, vote.type, vote.height, vote.round,
+                                 vote.block_id, ts)
+    return ts if trial == last_sign_bytes else None
+
+
+def _extract_proposal_timestamp(last_sign_bytes: bytes, chain_id: str,
+                                proposal: Proposal) -> Time | None:
+    from tendermint_tpu.encoding import proto as p
+    from tendermint_tpu.types.proposal import canonical_proposal_bytes
+
+    try:
+        body, _ = p.parse_delimited(last_sign_bytes)
+        f = p.fields(body)
+        ts = Time.unmarshal(f.get(6, [b""])[-1])
+    except Exception:  # noqa: BLE001
+        return None
+    trial = canonical_proposal_bytes(chain_id, proposal.height, proposal.round,
+                                     proposal.pol_round, proposal.block_id, ts)
+    return ts if trial == last_sign_bytes else None
+
+
+def _atomic_write_json(path: str, doc: dict) -> None:
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".pv-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class MockPV:
+    """In-process test signer (reference: types/priv_validator.go MockPV)."""
+
+    def __init__(self, priv_key=None):
+        self.priv_key = priv_key if priv_key is not None else ed25519.gen_priv_key()
+
+    def get_pub_key(self):
+        return self.priv_key.pub_key()
+
+    def get_address(self):
+        return self.priv_key.pub_key().address()
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        vote.signature = self.priv_key.sign(vote.sign_bytes(chain_id))
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        proposal.signature = self.priv_key.sign(proposal.sign_bytes(chain_id))

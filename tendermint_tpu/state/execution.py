@@ -1,0 +1,283 @@
+"""BlockExecutor: validates blocks, drives the ABCI app, applies validator
+updates (reference: state/execution.go:94,117,131,211,259,403).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.crypto import keys as crypto_keys
+from tendermint_tpu.state.state import State
+from tendermint_tpu.state.store import ABCIResponses, StateStore
+from tendermint_tpu.state.validation import validate_block
+from tendermint_tpu.types.block import Block
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.params import ConsensusParams
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+
+class BlockExecutionError(Exception):
+    pass
+
+
+def validator_updates_from_abci(updates: list[abci.ValidatorUpdate]) -> list[Validator]:
+    """reference: types/protobuf.go PB2TM.ValidatorUpdates."""
+    out = []
+    for vu in updates:
+        pub = crypto_keys.pubkey_from_type_bytes(vu.pub_key_type, vu.pub_key_bytes)
+        out.append(Validator.new(pub, vu.power))
+    return out
+
+
+def validate_validator_updates(updates: list[abci.ValidatorUpdate],
+                               params: ConsensusParams) -> None:
+    """reference: state/execution.go:379-401."""
+    for vu in updates:
+        if vu.power < 0:
+            raise BlockExecutionError(f"voting power can't be negative {vu}")
+        if vu.power == 0:
+            continue
+        if vu.pub_key_type not in params.validator.pub_key_types:
+            raise BlockExecutionError(
+                f"validator {vu} is using pubkey {vu.pub_key_type}, which is unsupported for consensus"
+            )
+
+
+class BlockExecutor:
+    """reference: state/execution.go:34-92."""
+
+    def __init__(self, state_store: StateStore, app, mempool=None, evidence_pool=None,
+                 event_bus=None, block_store=None, logger=None, metrics=None):
+        self.store = state_store
+        self.app = app  # proxy.AppConnConsensus-like (direct Application ok)
+        self.mempool = mempool
+        self.evidence_pool = evidence_pool
+        self.event_bus = event_bus
+        self.block_store = block_store
+        self.logger = logger
+        self.metrics = metrics
+
+    # --- proposal creation (reference: state/execution.go:94-129) ----------
+
+    def create_proposal_block(self, height: int, state: State, last_commit,
+                              proposer_address: bytes,
+                              block_time: Time | None = None) -> Block:
+        max_bytes = state.consensus_params.block.max_bytes
+        max_gas = state.consensus_params.block.max_gas
+        evidence = []
+        ev_size = 0
+        if self.evidence_pool is not None:
+            evidence, ev_size = self.evidence_pool.pending_evidence(
+                state.consensus_params.evidence.max_bytes
+            )
+        max_data = max_data_bytes(max_bytes, ev_size, state.validators.size())
+        txs = self.mempool.reap_max_bytes_max_gas(max_data, max_gas) if self.mempool else []
+        return state.make_block(height, txs, last_commit, evidence, proposer_address,
+                                block_time)
+
+    def validate_block(self, state: State, block: Block) -> None:
+        validate_block(state, block, self.block_store)
+        if self.evidence_pool is not None:
+            self.evidence_pool.check_evidence(state, block.evidence)
+
+    # --- applying a decided block (reference: state/execution.go:131-209) --
+
+    def apply_block(self, state: State, block_id: BlockID, block: Block) -> tuple[State, int]:
+        self.validate_block(state, block)
+
+        abci_responses = self._exec_block_on_app(state, block)
+        self.store.save_abci_responses(block.header.height, abci_responses)
+
+        end = abci_responses.end_block
+        validate_validator_updates(end.validator_updates, state.consensus_params)
+        validator_updates = validator_updates_from_abci(end.validator_updates)
+
+        new_state = update_state(state, block_id, block, abci_responses, validator_updates)
+
+        # Lock mempool, commit app state, update mempool (reference:
+        # state/execution.go:211-257).
+        app_hash, retain_height = self._commit(new_state, block, abci_responses)
+        if self.evidence_pool is not None:
+            self.evidence_pool.update(new_state, block.evidence)
+
+        new_state = replace(new_state, app_hash=app_hash)
+        self.store.save(new_state)
+
+        self._fire_events(block, block_id, abci_responses, validator_updates)
+        return new_state, retain_height
+
+    def _exec_block_on_app(self, state: State, block: Block) -> ABCIResponses:
+        """BeginBlock / DeliverTx* / EndBlock (reference:
+        state/execution.go:259-377)."""
+        commit_info = get_begin_block_validator_info(block, self.store, state.initial_height)
+        byz_vals = []
+        for ev in block.evidence:
+            byz_vals.extend(abci_evidence(ev, state))
+
+        begin_res = self.app.begin_block(abci.RequestBeginBlock(
+            hash=block.hash() or b"",
+            header=block.header,
+            last_commit_info=commit_info,
+            byzantine_validators=byz_vals,
+        ))
+        deliver_txs = []
+        invalid_count = 0
+        for tx in block.data.txs:
+            res = self.app.deliver_tx(abci.RequestDeliverTx(tx=tx))
+            if not res.is_ok():
+                invalid_count += 1
+            deliver_txs.append(res)
+        end_res = self.app.end_block(abci.RequestEndBlock(height=block.header.height))
+        return ABCIResponses(deliver_txs=deliver_txs, end_block=end_res, begin_block=begin_res)
+
+    def _commit(self, state: State, block: Block, abci_responses: ABCIResponses):
+        """reference: state/execution.go:211-257: flush mempool, app Commit,
+        mempool Update."""
+        if self.mempool is not None:
+            self.mempool.lock()
+        try:
+            res = self.app.commit()
+            if self.mempool is not None:
+                self.mempool.update(
+                    block.header.height, block.data.txs, abci_responses.deliver_txs,
+                )
+        finally:
+            if self.mempool is not None:
+                self.mempool.unlock()
+        return res.data, res.retain_height
+
+    def _fire_events(self, block: Block, block_id: BlockID,
+                     abci_responses: ABCIResponses, validator_updates) -> None:
+        """reference: state/execution.go:471-552."""
+        if self.event_bus is None:
+            return
+        from tendermint_tpu.types import events
+
+        self.event_bus.publish_event_new_block(
+            events.EventDataNewBlock(block=block, block_id=block_id,
+                                     result_begin_block=abci_responses.begin_block,
+                                     result_end_block=abci_responses.end_block))
+        self.event_bus.publish_event_new_block_header(
+            events.EventDataNewBlockHeader(header=block.header,
+                                           num_txs=len(block.data.txs),
+                                           result_begin_block=abci_responses.begin_block,
+                                           result_end_block=abci_responses.end_block))
+        for ev in block.evidence:
+            self.event_bus.publish_event_new_evidence(
+                events.EventDataNewEvidence(evidence=ev, height=block.header.height))
+        for i, tx in enumerate(block.data.txs):
+            self.event_bus.publish_event_tx(events.EventDataTx(
+                height=block.header.height, tx=tx, index=i,
+                result=abci_responses.deliver_txs[i]))
+        if validator_updates:
+            self.event_bus.publish_event_validator_set_updates(
+                events.EventDataValidatorSetUpdates(validator_updates=validator_updates))
+
+
+def update_state(state: State, block_id: BlockID, block: Block,
+                 abci_responses: ABCIResponses, validator_updates) -> State:
+    """reference: state/execution.go:403-469."""
+    n_val_set = state.next_validators.copy()
+    last_height_vals_changed = state.last_height_validators_changed
+    if validator_updates:
+        n_val_set.update_with_change_set(validator_updates)
+        last_height_vals_changed = block.header.height + 1 + 1
+
+    n_val_set.increment_proposer_priority(1)
+
+    next_params = state.consensus_params
+    last_height_params_changed = state.last_height_consensus_params_changed
+    if abci_responses.end_block is not None and abci_responses.end_block.consensus_param_updates is not None:
+        next_params = abci_responses.end_block.consensus_param_updates
+        next_params.validate_basic()
+        last_height_params_changed = block.header.height + 1
+
+    from tendermint_tpu.abci.types import results_hash
+
+    return State(
+        version=state.version,
+        chain_id=state.chain_id,
+        initial_height=state.initial_height,
+        last_block_height=block.header.height,
+        last_block_id=block_id,
+        last_block_time=block.header.time,
+        next_validators=n_val_set,
+        validators=state.next_validators.copy(),
+        last_validators=state.validators.copy(),
+        last_height_validators_changed=last_height_vals_changed,
+        consensus_params=next_params,
+        last_height_consensus_params_changed=last_height_params_changed,
+        last_results_hash=results_hash(abci_responses.deliver_txs),
+        app_hash=b"",  # set after Commit
+    )
+
+
+def get_begin_block_validator_info(block: Block, store: StateStore,
+                                   initial_height: int) -> abci.LastCommitInfo:
+    """reference: state/execution.go:307-352."""
+    vote_infos = []
+    if block.header.height > initial_height:
+        last_val_set = store.load_validators(block.header.height - 1)
+        commit_size = block.last_commit.size()
+        vals_size = last_val_set.size()
+        if commit_size != vals_size:
+            raise BlockExecutionError(
+                f"commit size ({commit_size}) doesn't match valset length ({vals_size}) "
+                f"at height {block.header.height}"
+            )
+        for i, val in enumerate(last_val_set.validators):
+            cs = block.last_commit.signatures[i]
+            vote_infos.append(abci.VoteInfo(
+                validator=abci.ABCIValidator(address=val.address, power=val.voting_power),
+                signed_last_block=not cs.absent(),
+            ))
+    round_ = block.last_commit.round if block.last_commit else 0
+    return abci.LastCommitInfo(round=round_, votes=vote_infos)
+
+
+def abci_evidence(ev, state: State) -> list[abci.ABCIEvidence]:
+    """types.Evidence.ABCI() equivalents (reference: types/evidence.go:76,203)."""
+    from tendermint_tpu.types.evidence import DuplicateVoteEvidence, LightClientAttackEvidence
+
+    if isinstance(ev, DuplicateVoteEvidence):
+        return [abci.ABCIEvidence(
+            type=abci.EVIDENCE_TYPE_DUPLICATE_VOTE,
+            validator=abci.ABCIValidator(address=ev.vote_a.validator_address,
+                                         power=ev.validator_power),
+            height=ev.vote_a.height,
+            time_seconds=ev.timestamp.seconds,
+            time_nanos=ev.timestamp.nanos,
+            total_voting_power=ev.total_voting_power,
+        )]
+    if isinstance(ev, LightClientAttackEvidence):
+        out = []
+        for v in ev.byzantine_validators:
+            out.append(abci.ABCIEvidence(
+                type=abci.EVIDENCE_TYPE_LIGHT_CLIENT_ATTACK,
+                validator=abci.ABCIValidator(address=v.address, power=v.voting_power),
+                height=ev.height(),
+                time_seconds=ev.timestamp.seconds,
+                time_nanos=ev.timestamp.nanos,
+                total_voting_power=ev.total_voting_power,
+            ))
+        return out
+    return []
+
+
+def max_data_bytes(max_bytes: int, evidence_bytes: int, num_vals: int) -> int:
+    """reference: types/block.go MaxDataBytes."""
+    MAX_OVERHEAD_FOR_BLOCK = 11
+    MAX_HEADER_BYTES = 626
+    MAX_COMMIT_OVERHEAD = 94
+    MAX_COMMIT_SIG_BYTES = 109
+    max_data = (max_bytes - MAX_OVERHEAD_FOR_BLOCK - MAX_HEADER_BYTES
+                - MAX_COMMIT_OVERHEAD - num_vals * MAX_COMMIT_SIG_BYTES
+                - evidence_bytes)
+    if max_data < 0:
+        raise BlockExecutionError(
+            f"negative MaxDataBytes. Block.MaxBytes={max_bytes} is too small to accommodate header&lastCommit&evidence"
+        )
+    return max_data

@@ -1,0 +1,121 @@
+"""State: the chain-tip snapshot between blocks (reference: state/state.go:34,
+state/state.go:300-354 MakeGenesisState)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from tendermint_tpu.types.block import BLOCK_PROTOCOL, Consensus
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.genesis import GenesisDoc
+from tendermint_tpu.types.params import ConsensusParams
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+INIT_STATE_VERSION = Consensus(block=BLOCK_PROTOCOL, app=0)
+
+
+@dataclass
+class State:
+    version: Consensus = field(default_factory=lambda: INIT_STATE_VERSION)
+    chain_id: str = ""
+    initial_height: int = 1
+
+    last_block_height: int = 0
+    last_block_id: BlockID = field(default_factory=BlockID)
+    last_block_time: Time = field(default_factory=Time.zero)
+
+    # validators at height+1, height, height-1 (reference: state/state.go:60-75)
+    next_validators: ValidatorSet | None = None
+    validators: ValidatorSet | None = None
+    last_validators: ValidatorSet | None = None
+    last_height_validators_changed: int = 0
+
+    consensus_params: ConsensusParams = field(default_factory=ConsensusParams)
+    last_height_consensus_params_changed: int = 0
+
+    last_results_hash: bytes = b""
+    app_hash: bytes = b""
+
+    def copy(self) -> "State":
+        return replace(
+            self,
+            next_validators=self.next_validators.copy() if self.next_validators else None,
+            validators=self.validators.copy() if self.validators else None,
+            last_validators=self.last_validators.copy() if self.last_validators else None,
+        )
+
+    def is_empty(self) -> bool:
+        return self.validators is None
+
+    def equals(self, other: "State") -> bool:
+        return (
+            self.chain_id == other.chain_id
+            and self.last_block_height == other.last_block_height
+            and self.app_hash == other.app_hash
+            and self.last_block_id == other.last_block_id
+        )
+
+    def make_block(self, height: int, txs: list[bytes], last_commit, evidence,
+                   proposer_address: bytes, block_time: Time | None = None):
+        """reference: state/state.go:230-263 MakeBlock: block time is the
+        genesis time for the initial block, else the weighted median of the
+        last commit's timestamps (MedianTime)."""
+        from tendermint_tpu.types.block import Block, Data, Header
+
+        if block_time is None:
+            if height == self.initial_height:
+                block_time = self.last_block_time  # genesis time
+            else:
+                from tendermint_tpu.state.validation import median_time
+
+                block_time = median_time(last_commit, self.last_validators)
+
+        block = Block(
+            header=Header(
+                version=self.version,
+                chain_id=self.chain_id,
+                height=height,
+                time=block_time,
+                last_block_id=self.last_block_id,
+                validators_hash=self.validators.hash(),
+                next_validators_hash=self.next_validators.hash(),
+                consensus_hash=self.consensus_params.hash(),
+                app_hash=self.app_hash,
+                last_results_hash=self.last_results_hash,
+                proposer_address=proposer_address,
+            ),
+            data=Data(txs=list(txs)),
+            evidence=list(evidence),
+            last_commit=last_commit,
+        )
+        block.fill_header()
+        return block
+
+
+def make_genesis_state(genesis: GenesisDoc) -> State:
+    """reference: state/state.go:300-354."""
+    genesis.validate_and_complete()
+    if genesis.validators:
+        vals = [Validator.new(v.pub_key, v.power) for v in genesis.validators]
+        val_set = ValidatorSet(vals)
+        next_vals = val_set.copy_increment_proposer_priority(1)
+    else:
+        val_set = ValidatorSet()  # awaiting InitChain response
+        next_vals = ValidatorSet()
+    return State(
+        version=Consensus(block=BLOCK_PROTOCOL, app=(genesis.consensus_params or ConsensusParams()).version.app_version),
+        chain_id=genesis.chain_id,
+        initial_height=genesis.initial_height,
+        last_block_height=0,
+        last_block_id=BlockID(),
+        last_block_time=genesis.genesis_time,
+        next_validators=next_vals,
+        validators=val_set,
+        last_validators=ValidatorSet(),
+        last_height_validators_changed=genesis.initial_height,
+        consensus_params=genesis.consensus_params or ConsensusParams(),
+        last_height_consensus_params_changed=genesis.initial_height,
+        app_hash=genesis.app_hash,
+    )

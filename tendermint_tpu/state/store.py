@@ -1,0 +1,248 @@
+"""State store: persists State + per-height validator/params history + ABCI
+responses (reference: state/store.go:100-661).
+
+Layout:
+  stateKey                    -> full State
+  validatorsKey:<height>      -> ValidatorsInfo {set | last_height_changed}
+  consensusParamsKey:<height> -> ConsensusParamsInfo {params | last_height_changed}
+  abciResponsesKey:<height>   -> serialized DeliverTx responses + EndBlock
+
+The validator history trick mirrors the reference: heights where nothing
+changed store only a back-pointer to last_height_changed
+(state/store.go:483-560), so lookups may take one indirection.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.abci.types import ResponseDeliverTx
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.state.state import State
+from tendermint_tpu.store.db import DB
+from tendermint_tpu.types.block import Consensus
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.params import ConsensusParams
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+_STATE_KEY = b"stateKey"
+VALSET_CHECK_INTERVAL = 100000  # reference: state/store.go valSetCheckpointInterval
+
+
+def _val_key(h: int) -> bytes:
+    return b"validatorsKey:%020d" % h
+
+
+def _params_key(h: int) -> bytes:
+    return b"consensusParamsKey:%020d" % h
+
+
+def _abci_key(h: int) -> bytes:
+    return b"abciResponsesKey:%020d" % h
+
+
+class StateStoreError(Exception):
+    pass
+
+
+class ErrNoValSetForHeight(StateStoreError):
+    def __init__(self, height: int):
+        super().__init__(f"could not find validator set for height #{height}")
+
+
+def _marshal_state(s: State) -> bytes:
+    w = proto.Writer()
+    w.message(1, s.version.marshal(), always=True)
+    w.string(2, s.chain_id)
+    w.varint(3, s.last_block_height)
+    w.message(4, s.last_block_id.marshal(), always=True)
+    w.message(5, s.last_block_time.marshal(), always=True)
+    w.message(6, s.next_validators.marshal() if s.next_validators else b"", always=True)
+    w.message(7, s.validators.marshal() if s.validators else b"", always=True)
+    w.message(8, s.last_validators.marshal() if s.last_validators else b"", always=True)
+    w.varint(9, s.last_height_validators_changed)
+    w.message(10, s.consensus_params.marshal(), always=True)
+    w.varint(11, s.last_height_consensus_params_changed)
+    w.bytes(12, s.last_results_hash)
+    w.bytes(13, s.app_hash)
+    w.varint(14, s.initial_height)
+    return w.out()
+
+
+def _unmarshal_state(buf: bytes) -> State:
+    f = proto.fields(buf)
+    return State(
+        version=Consensus.unmarshal(f.get(1, [b""])[-1]),
+        chain_id=f.get(2, [b""])[-1].decode() if 2 in f else "",
+        last_block_height=proto.as_sint64(f.get(3, [0])[-1]),
+        last_block_id=BlockID.unmarshal(f.get(4, [b""])[-1]),
+        last_block_time=Time.unmarshal(f.get(5, [b""])[-1]),
+        next_validators=ValidatorSet.unmarshal(f.get(6, [b""])[-1]),
+        validators=ValidatorSet.unmarshal(f.get(7, [b""])[-1]),
+        last_validators=ValidatorSet.unmarshal(f.get(8, [b""])[-1]),
+        last_height_validators_changed=proto.as_sint64(f.get(9, [0])[-1]),
+        consensus_params=ConsensusParams.unmarshal(f.get(10, [b""])[-1]),
+        last_height_consensus_params_changed=proto.as_sint64(f.get(11, [0])[-1]),
+        last_results_hash=f.get(12, [b""])[-1],
+        app_hash=f.get(13, [b""])[-1],
+        initial_height=proto.as_sint64(f.get(14, [1])[-1]) or 1,
+    )
+
+
+class ABCIResponses:
+    """reference: state/store.go:60-75 (tmstate.ABCIResponses)."""
+
+    def __init__(self, deliver_txs: list[ResponseDeliverTx] | None = None,
+                 end_block=None, begin_block=None):
+        self.deliver_txs = deliver_txs or []
+        self.end_block = end_block
+        self.begin_block = begin_block
+
+    def marshal(self) -> bytes:
+        w = proto.Writer()
+        for r in self.deliver_txs:
+            w.message(1, r.marshal(), always=True)
+        return w.out()
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "ABCIResponses":
+        f = proto.fields(buf)
+        return ABCIResponses(
+            deliver_txs=[ResponseDeliverTx.unmarshal(b) for b in f.get(1, [])]
+        )
+
+
+class StateStore:
+    def __init__(self, db: DB):
+        self._db = db
+
+    # --- state -------------------------------------------------------------
+
+    def load(self) -> State:
+        raw = self._db.get(_STATE_KEY)
+        if raw is None:
+            return State()
+        return _unmarshal_state(raw)
+
+    def save(self, state: State) -> None:
+        """Persist state + index validator/params history (reference:
+        state/store.go:174-205)."""
+        next_height = state.last_block_height + 1
+        if next_height == 1:
+            next_height = state.initial_height
+            self._save_validators(next_height, state.last_height_validators_changed,
+                                  state.validators)
+        self._save_validators(next_height + 1, state.last_height_validators_changed,
+                              state.next_validators)
+        self._save_params(next_height, state.last_height_consensus_params_changed,
+                          state.consensus_params)
+        self._db.set(_STATE_KEY, _marshal_state(state))
+
+    def bootstrap(self, state: State) -> None:
+        """reference: state/store.go:207-241."""
+        height = state.last_block_height + 1
+        if height == 1:
+            height = state.initial_height
+        if height > 1 and state.last_validators and not state.last_validators.is_nil_or_empty():
+            self._save_validators(height - 1, height - 1, state.last_validators)
+        self._save_validators(height, height, state.validators)
+        self._save_validators(height + 1, height + 1, state.next_validators)
+        self._save_params(height, state.last_height_consensus_params_changed,
+                          state.consensus_params)
+        self._db.set(_STATE_KEY, _marshal_state(state))
+
+    # --- validator history -------------------------------------------------
+
+    def _save_validators(self, height: int, last_changed: int, vals: ValidatorSet) -> None:
+        if vals is None:
+            return
+        if last_changed == height or height % VALSET_CHECK_INTERVAL == 0:
+            body = proto.Writer().message(1, vals.marshal(), always=True).varint(2, last_changed).out()
+        else:
+            body = proto.Writer().varint(2, last_changed).out()
+        self._db.set(_val_key(height), body)
+
+    def load_validators(self, height: int) -> ValidatorSet:
+        """reference: state/store.go:483-530 (with back-pointer chase)."""
+        raw = self._db.get(_val_key(height))
+        if raw is None:
+            raise ErrNoValSetForHeight(height)
+        f = proto.fields(raw)
+        if 1 in f:
+            return ValidatorSet.unmarshal(f[1][-1])
+        last_changed = proto.as_sint64(f.get(2, [0])[-1])
+        raw2 = self._db.get(_val_key(last_changed))
+        if raw2 is None:
+            raise ErrNoValSetForHeight(height)
+        f2 = proto.fields(raw2)
+        if 1 not in f2:
+            raise StateStoreError(
+                f"validator checkpoint at height {last_changed} is itself a pointer"
+            )
+        return ValidatorSet.unmarshal(f2[1][-1])
+
+    # --- consensus params history ------------------------------------------
+
+    def _save_params(self, height: int, last_changed: int, params: ConsensusParams) -> None:
+        if last_changed == height:
+            body = proto.Writer().message(1, params.marshal(), always=True).varint(2, last_changed).out()
+        else:
+            body = proto.Writer().varint(2, last_changed).out()
+        self._db.set(_params_key(height), body)
+
+    def load_consensus_params(self, height: int) -> ConsensusParams:
+        raw = self._db.get(_params_key(height))
+        if raw is None:
+            raise StateStoreError(f"could not find consensus params for height #{height}")
+        f = proto.fields(raw)
+        if 1 in f:
+            return ConsensusParams.unmarshal(f[1][-1])
+        last_changed = proto.as_sint64(f.get(2, [0])[-1])
+        raw2 = self._db.get(_params_key(last_changed))
+        if raw2 is None:
+            raise StateStoreError(f"could not find consensus params for height #{height}")
+        f2 = proto.fields(raw2)
+        return ConsensusParams.unmarshal(f2[1][-1])
+
+    # --- ABCI responses ----------------------------------------------------
+
+    def save_abci_responses(self, height: int, responses: ABCIResponses) -> None:
+        self._db.set(_abci_key(height), responses.marshal())
+
+    def load_abci_responses(self, height: int) -> ABCIResponses:
+        raw = self._db.get(_abci_key(height))
+        if raw is None:
+            raise StateStoreError(f"could not find ABCI responses for height #{height}")
+        return ABCIResponses.unmarshal(raw)
+
+    # --- pruning -----------------------------------------------------------
+
+    def prune_states(self, base: int, height: int) -> None:
+        """Deletes history in [base, height) (reference: state/store.go:243-330).
+
+        Surviving heights may hold back-pointers into the pruned range, so the
+        retain boundary `height` is first rewritten as FULL validator/params
+        rows (the reference does the same with its keepVals/keepParams sets)."""
+        if base <= 0 or height <= base:
+            raise StateStoreError(f"invalid range {base}..{height}")
+        # Materialize the boundary rows before deleting what they point into.
+        boundary_vals = self.load_validators(height)
+        self._save_validators(height, height, boundary_vals)
+        try:
+            boundary_params = self.load_consensus_params(height)
+            self._save_params(height, height, boundary_params)
+        except StateStoreError:
+            pass
+        # A pointer one past the boundary (height+1 row saved by save()) may
+        # also reference the pruned range.
+        try:
+            next_vals = self.load_validators(height + 1)
+            self._save_validators(height + 1, height + 1, next_vals)
+        except ErrNoValSetForHeight:
+            pass
+        deletes = []
+        for h in range(base, height):
+            if h % VALSET_CHECK_INTERVAL != 0:
+                deletes.append(_val_key(h))
+            deletes.append(_params_key(h))
+            deletes.append(_abci_key(h))
+        self._db.write_batch([], deletes)

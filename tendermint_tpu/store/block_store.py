@@ -1,0 +1,202 @@
+"""BlockStore: persists blocks as meta + parts + commits (reference:
+store/store.go:93,203,226,248,332).
+
+Layout (one KV row per item, like the reference's calc*Key scheme):
+  H:<height>        -> BlockMeta proto
+  P:<height>:<idx>  -> Part proto
+  C:<height>        -> Commit proto   (LastCommit of height+1)
+  SC:<height>       -> Commit proto   (locally seen commit for height)
+  BH:<hash>         -> height (decimal)
+  blockStore        -> BlockStoreState {base, height}
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field as dc_field
+
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.store.db import DB
+from tendermint_tpu.types.block import Block, Commit, Header
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.part_set import Part, PartSet
+
+
+@dataclass
+class BlockMeta:
+    """reference: types/block_meta.go."""
+
+    block_id: BlockID = dc_field(default_factory=BlockID)
+    block_size: int = 0
+    header: Header = dc_field(default_factory=Header)
+    num_txs: int = 0
+
+    def marshal(self) -> bytes:
+        return (
+            proto.Writer()
+            .message(1, self.block_id.marshal(), always=True)
+            .varint(2, self.block_size)
+            .message(3, self.header.marshal(), always=True)
+            .varint(4, self.num_txs)
+            .out()
+        )
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "BlockMeta":
+        f = proto.fields(buf)
+        return BlockMeta(
+            block_id=BlockID.unmarshal(f.get(1, [b""])[-1]),
+            block_size=proto.as_sint64(f.get(2, [0])[-1]),
+            header=Header.unmarshal(f.get(3, [b""])[-1]),
+            num_txs=proto.as_sint64(f.get(4, [0])[-1]),
+        )
+
+
+def _meta_key(h: int) -> bytes:
+    return b"H:%020d" % h
+
+
+def _part_key(h: int, i: int) -> bytes:
+    return b"P:%020d:%08d" % (h, i)
+
+
+def _commit_key(h: int) -> bytes:
+    return b"C:%020d" % h
+
+
+def _seen_commit_key(h: int) -> bytes:
+    return b"SC:%020d" % h
+
+
+def _hash_key(block_hash: bytes) -> bytes:
+    return b"BH:" + block_hash
+
+
+_STATE_KEY = b"blockStore"
+
+
+class BlockStore:
+    """Thread-safe; mirrors store/store.go semantics including pruning."""
+
+    def __init__(self, db: DB):
+        self._db = db
+        self._mtx = threading.RLock()
+        st = db.get(_STATE_KEY)
+        if st is None:
+            self.base = 0
+            self.height = 0
+        else:
+            f = proto.fields(st)
+            self.base = proto.as_sint64(f.get(1, [0])[-1])
+            self.height = proto.as_sint64(f.get(2, [0])[-1])
+
+    # --- accessors ---------------------------------------------------------
+
+    def size(self) -> int:
+        with self._mtx:
+            return 0 if self.height == 0 else self.height - self.base + 1
+
+    def load_base_meta(self) -> BlockMeta | None:
+        with self._mtx:
+            return self.load_block_meta(self.base) if self.base else None
+
+    def load_block_meta(self, height: int) -> BlockMeta | None:
+        raw = self._db.get(_meta_key(height))
+        return BlockMeta.unmarshal(raw) if raw is not None else None
+
+    def load_block(self, height: int) -> Block | None:
+        meta = self.load_block_meta(height)
+        if meta is None:
+            return None
+        parts = []
+        for i in range(meta.block_id.part_set_header.total):
+            raw = self._db.get(_part_key(height, i))
+            if raw is None:
+                return None
+            parts.append(Part.unmarshal(raw).bytes_)
+        return Block.unmarshal(b"".join(parts))
+
+    def load_block_by_hash(self, block_hash: bytes) -> Block | None:
+        raw = self._db.get(_hash_key(block_hash))
+        if raw is None:
+            return None
+        return self.load_block(int(raw.decode()))
+
+    def load_block_part(self, height: int, index: int) -> Part | None:
+        raw = self._db.get(_part_key(height, index))
+        return Part.unmarshal(raw) if raw is not None else None
+
+    def load_block_commit(self, height: int) -> Commit | None:
+        """Commit for `height` stored with block height+1 (reference:
+        store/store.go:203)."""
+        raw = self._db.get(_commit_key(height))
+        return Commit.unmarshal(raw) if raw is not None else None
+
+    def load_seen_commit(self, height: int) -> Commit | None:
+        raw = self._db.get(_seen_commit_key(height))
+        return Commit.unmarshal(raw) if raw is not None else None
+
+    # --- mutation ----------------------------------------------------------
+
+    def save_block(self, block: Block, part_set: PartSet, seen_commit: Commit) -> None:
+        """reference: store/store.go:332-383."""
+        if block is None:
+            raise ValueError("BlockStore can only save a non-nil block")
+        height = block.header.height
+        with self._mtx:
+            want = self.height + 1
+            if self.height > 0 and height != want:
+                raise ValueError(f"BlockStore can only save contiguous blocks. Wanted {want}, got {height}")
+            if not part_set.is_complete():
+                raise ValueError("BlockStore can only save complete block part sets")
+
+            block_id = BlockID(hash=block.hash(), part_set_header=part_set.header())
+            meta = BlockMeta(
+                block_id=block_id,
+                block_size=sum(len(p.bytes_) for p in part_set.parts),
+                header=block.header,
+                num_txs=len(block.data.txs),
+            )
+            sets = [(_meta_key(height), meta.marshal()),
+                    (_hash_key(block.hash()), str(height).encode())]
+            for i, part in enumerate(part_set.parts):
+                sets.append((_part_key(height, i), part.marshal()))
+            if block.last_commit is not None:
+                sets.append((_commit_key(height - 1), block.last_commit.marshal()))
+            sets.append((_seen_commit_key(height), seen_commit.marshal()))
+
+            self.height = height
+            if self.base == 0:
+                self.base = height
+            sets.append((_STATE_KEY, self._state_bytes()))
+            self._db.write_batch(sets)
+
+    def prune_blocks(self, height: int) -> int:
+        """Removes blocks below `height`, keeping `height` (reference:
+        store/store.go:248-330). Returns number pruned."""
+        with self._mtx:
+            if height <= 0:
+                raise ValueError("height must be greater than 0")
+            if height > self.height:
+                raise ValueError(f"cannot prune beyond the latest height {self.height}")
+            if height < self.base:
+                return 0
+            pruned = 0
+            deletes: list[bytes] = []
+            for h in range(self.base, height):
+                meta = self.load_block_meta(h)
+                if meta is None:
+                    continue
+                deletes.append(_meta_key(h))
+                deletes.append(_hash_key(meta.block_id.hash))
+                deletes.append(_commit_key(h - 1))
+                deletes.append(_seen_commit_key(h))
+                for i in range(meta.block_id.part_set_header.total):
+                    deletes.append(_part_key(h, i))
+                pruned += 1
+            self.base = height
+            self._db.write_batch([(_STATE_KEY, self._state_bytes())], deletes)
+            return pruned
+
+    def _state_bytes(self) -> bytes:
+        return proto.Writer().varint(1, self.base).varint(2, self.height).out()

@@ -1,0 +1,187 @@
+"""Embedded key-value store: the tm-db equivalent.
+
+The reference depends on github.com/tendermint/tm-db (go.mod:43) with
+pluggable backends (goleveldb default, cleveldb/rocksdb/boltdb/badgerdb);
+selection via Config.DBBackend (node/node.go:76-79). Here: "memdb" (tests,
+ephemeral) and "sqlite" (durable, stdlib, WAL-mode) behind the same
+interface. Iteration is byte-ordered like tm-db's.
+"""
+
+from __future__ import annotations
+
+import abc
+import bisect
+import sqlite3
+import threading
+from pathlib import Path
+
+
+class DB(abc.ABC):
+    @abc.abstractmethod
+    def get(self, key: bytes) -> bytes | None: ...
+
+    @abc.abstractmethod
+    def set(self, key: bytes, value: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, key: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def iterator(self, start: bytes | None = None, end: bytes | None = None):
+        """Yield (key, value) ascending for start <= key < end."""
+
+    @abc.abstractmethod
+    def reverse_iterator(self, start: bytes | None = None, end: bytes | None = None):
+        """Yield (key, value) descending for start <= key < end."""
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def write_batch(self, sets: list[tuple[bytes, bytes]], deletes: list[bytes] = ()) -> None:
+        for k, v in sets:
+            self.set(k, v)
+        for k in deletes:
+            self.delete(k)
+
+    def close(self) -> None:
+        pass
+
+
+class MemDB(DB):
+    def __init__(self) -> None:
+        self._data: dict[bytes, bytes] = {}
+        self._keys: list[bytes] = []
+        self._lock = threading.RLock()
+
+    def get(self, key: bytes) -> bytes | None:
+        with self._lock:
+            return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            if key not in self._data:
+                bisect.insort(self._keys, key)
+            self._data[key] = value
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            if key in self._data:
+                del self._data[key]
+                i = bisect.bisect_left(self._keys, key)
+                del self._keys[i]
+
+    def iterator(self, start=None, end=None):
+        with self._lock:
+            lo = 0 if start is None else bisect.bisect_left(self._keys, start)
+            hi = len(self._keys) if end is None else bisect.bisect_left(self._keys, end)
+            keys = self._keys[lo:hi]
+        for k in keys:
+            v = self.get(k)
+            if v is not None:
+                yield k, v
+
+    def reverse_iterator(self, start=None, end=None):
+        with self._lock:
+            lo = 0 if start is None else bisect.bisect_left(self._keys, start)
+            hi = len(self._keys) if end is None else bisect.bisect_left(self._keys, end)
+            keys = self._keys[lo:hi]
+        for k in reversed(keys):
+            v = self.get(k)
+            if v is not None:
+                yield k, v
+
+
+class SQLiteDB(DB):
+    """Durable backend on stdlib sqlite3 (WAL mode, fsync on commit)."""
+
+    def __init__(self, path: str) -> None:
+        Path(path).parent.mkdir(parents=True, exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL)"
+            )
+            self._conn.commit()
+
+    def get(self, key: bytes) -> bytes | None:
+        with self._lock:
+            row = self._conn.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        return row[0] if row else None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO kv (k, v) VALUES (?, ?) ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+                (key, value),
+            )
+            self._conn.commit()
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE k = ?", (key,))
+            self._conn.commit()
+
+    def write_batch(self, sets, deletes=()) -> None:
+        with self._lock:
+            self._conn.executemany(
+                "INSERT INTO kv (k, v) VALUES (?, ?) ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+                list(sets),
+            )
+            if deletes:
+                self._conn.executemany("DELETE FROM kv WHERE k = ?", [(k,) for k in deletes])
+            self._conn.commit()
+
+    def iterator(self, start=None, end=None):
+        q, params = self._range_query(start, end, "ASC")
+        with self._lock:
+            rows = self._conn.execute(q, params).fetchall()
+        yield from rows
+
+    def reverse_iterator(self, start=None, end=None):
+        q, params = self._range_query(start, end, "DESC")
+        with self._lock:
+            rows = self._conn.execute(q, params).fetchall()
+        yield from rows
+
+    @staticmethod
+    def _range_query(start, end, order):
+        q = "SELECT k, v FROM kv"
+        conds, params = [], []
+        if start is not None:
+            conds.append("k >= ?")
+            params.append(start)
+        if end is not None:
+            conds.append("k < ?")
+            params.append(end)
+        if conds:
+            q += " WHERE " + " AND ".join(conds)
+        q += f" ORDER BY k {order}"
+        return q, params
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.commit()
+            self._conn.close()
+
+
+def prefix_end(prefix: bytes) -> bytes | None:
+    """Smallest key greater than every key with this prefix."""
+    b = bytearray(prefix)
+    for i in reversed(range(len(b))):
+        if b[i] != 0xFF:
+            b[i] += 1
+            return bytes(b[: i + 1])
+    return None
+
+
+def new_db(backend: str, path: str | None = None) -> DB:
+    if backend == "memdb":
+        return MemDB()
+    if backend == "sqlite":
+        if path is None:
+            raise ValueError("sqlite backend needs a path")
+        return SQLiteDB(path)
+    raise ValueError(f"unknown db backend {backend!r}")

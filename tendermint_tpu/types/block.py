@@ -323,8 +323,6 @@ class Block:
     evidence: list = dc_field(default_factory=list)
     last_commit: Commit | None = None
 
-    _hash_cache: bytes | None = None
-
     def hash(self) -> bytes | None:
         """Header hash, with LastCommitHash filled (reference:
         types/block.go:123-141 fillHeader + Hash)."""
@@ -401,16 +399,17 @@ def evidence_list_marshal(evidence: list) -> bytes:
 
 def make_commit(block_id: BlockID, height: int, round_: int, votes) -> Commit:
     """Build a Commit from a VoteSet's ordered vote slots (reference:
-    types/vote_set.go MakeCommit)."""
+    types/vote_set.go:612-636 MakeCommit + types/vote.go:62 CommitSig): a
+    vote for a block OTHER than the maj23 block is excluded (absent), not
+    marked nil -- its signature signs a different BlockID."""
     sigs = []
     for v in votes:
         if v is None:
             sigs.append(CommitSig.new_absent())
-        else:
-            flag = BLOCK_ID_FLAG_NIL if v.block_id.is_zero() else BLOCK_ID_FLAG_COMMIT
-            if not v.block_id.is_zero() and v.block_id != block_id:
-                flag = BLOCK_ID_FLAG_NIL  # vote for a different block counts as nil here
-            sigs.append(
-                CommitSig(flag, v.validator_address, v.timestamp, v.signature)
-            )
+            continue
+        flag = BLOCK_ID_FLAG_NIL if v.block_id.is_zero() else BLOCK_ID_FLAG_COMMIT
+        if flag == BLOCK_ID_FLAG_COMMIT and v.block_id != block_id:
+            sigs.append(CommitSig.new_absent())
+            continue
+        sigs.append(CommitSig(flag, v.validator_address, v.timestamp, v.signature))
     return Commit(height=height, round=round_, block_id=block_id, signatures=sigs)

@@ -30,6 +30,7 @@ from tendermint_tpu.types.validator import (
     clip_int64,
 )
 
+# Implied validator-set size cap (reference: types/validator_set.go MaxVotesCount)
 MAX_VOTES_COUNT = 10000
 
 

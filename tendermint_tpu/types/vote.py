@@ -29,9 +29,6 @@ BLOCK_ID_FLAG_ABSENT = 1
 BLOCK_ID_FLAG_COMMIT = 2
 BLOCK_ID_FLAG_NIL = 3
 
-MAX_VOTES_COUNT = 10000  # reference: types/validator_set.go MaxVotesCount
-
-
 def is_vote_type_valid(t: int) -> bool:
     return t in (PREVOTE_TYPE, PRECOMMIT_TYPE)
 

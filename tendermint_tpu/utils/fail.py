@@ -1,0 +1,22 @@
+"""Crash-injection points (reference: libs/fail/fail.go:10-38).
+
+Set TMTPU_FAIL_INDEX=N to make the N-th fail_point() call in the process
+exit hard (os._exit), simulating a crash between commit steps for
+crash-consistency tests (reference call sites: state/execution.go:149-196,
+consensus/state.go:1605-1685)."""
+
+from __future__ import annotations
+
+import os
+
+_counter = 0
+
+
+def fail_point() -> None:
+    global _counter
+    target = os.environ.get("TMTPU_FAIL_INDEX")
+    if target is None:
+        return
+    if _counter == int(target):
+        os._exit(1)
+    _counter += 1

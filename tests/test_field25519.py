@@ -67,6 +67,19 @@ def test_chained_ops_stay_bounded():
         assert fe.to_int(bm[i]) == gb[i]
 
 
+def test_sub_with_max_top_limb():
+    """Regression: b's top limb can legitimately reach 8191 (loose NORM);
+    the fat-limb bias in sub must cover it (every bias limb >= 9500)."""
+    assert int(fe.PSUB_LIMBS.min()) >= 9500
+    # craft b with all limbs at the max a carry pass can emit (8191) and a=0
+    b_limbs = np.full((1, fe.NLIMB), 8191, dtype=np.int32)
+    b_int = fe.to_int(b_limbs[0])
+    a = jnp.zeros((1, fe.NLIMB), dtype=jnp.int32)
+    d = fe.sub(a, jnp.asarray(b_limbs))
+    assert int(jnp.min(d)) >= 0 and int(jnp.max(d)) < 9500
+    assert fe.to_int(np.asarray(fe.to_canonical(d))[0]) == (-b_int) % fe.P
+
+
 def test_inv():
     vals = [v for v in _rand_ints(16) if v != 0]
     a = _to_dev(vals)

@@ -1,0 +1,206 @@
+"""Storage, privval, mempool, and block execution: unit + end-to-end apply."""
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.mempool.mempool import ErrTxInCache, Mempool
+from tendermint_tpu.privval.file_pv import DoubleSignError, FilePV, MockPV
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import make_genesis_state
+from tendermint_tpu.state.store import ABCIResponses, StateStore
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.store.db import MemDB, SQLiteDB
+from tendermint_tpu.types.block import Commit, CommitSig
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.vote import (
+    BLOCK_ID_FLAG_COMMIT,
+    PRECOMMIT_TYPE,
+    PREVOTE_TYPE,
+    Vote,
+)
+
+
+def test_db_backends(tmp_path):
+    for db in (MemDB(), SQLiteDB(str(tmp_path / "kv.db"))):
+        db.set(b"a", b"1")
+        db.set(b"b", b"2")
+        db.set(b"c", b"3")
+        db.delete(b"b")
+        assert db.get(b"a") == b"1" and db.get(b"b") is None
+        assert [k for k, _ in db.iterator(b"a", b"c")] == [b"a"]
+        assert [k for k, _ in db.iterator()] == [b"a", b"c"]
+        assert [k for k, _ in db.reverse_iterator()] == [b"c", b"a"]
+        db.close()
+
+
+def _genesis(n_vals=1, chain_id="exec-chain"):
+    privs = [ed25519.gen_priv_key(bytes([40 + i]) * 32) for i in range(n_vals)]
+    gvals = [GenesisValidator(b"", p.pub_key(), 10) for p in privs]
+    gd = GenesisDoc(chain_id=chain_id, validators=gvals,
+                    genesis_time=Time(1700000000, 0))
+    gd.validate_and_complete()
+    return gd, privs
+
+
+def _commit_for(state, block, privs, round_=0):
+    bid = BlockID(hash=block.hash(),
+                  part_set_header=PartSet.from_data(block.marshal()).header())
+    sigs = []
+    by_addr = {p.pub_key().address(): p for p in privs}
+    for val in state.validators.validators:
+        priv = by_addr[val.address]
+        v = Vote(type=PRECOMMIT_TYPE, height=block.header.height, round=round_,
+                 block_id=bid, timestamp=block.header.time.add_ns(1_000_000),
+                 validator_address=val.address,
+                 validator_index=state.validators.get_by_address(val.address)[0])
+        v.signature = priv.sign(v.sign_bytes(state.chain_id))
+        sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, val.address, v.timestamp, v.signature))
+    return bid, Commit(height=block.header.height, round=round_, block_id=bid,
+                       signatures=sigs)
+
+
+def test_block_executor_applies_chain():
+    """Drive three blocks through BlockExecutor + kvstore end to end."""
+    gd, privs = _genesis(3)
+    state = make_genesis_state(gd)
+    app = KVStoreApplication()
+    store = StateStore(MemDB())
+    store.save(state)
+    mp = Mempool(app)
+    bx = BlockExecutor(store, app, mempool=mp)
+
+    last_commit = Commit(height=0, round=0, block_id=BlockID(), signatures=[])
+    for h in range(1, 4):
+        mp.check_tx(b"k%d=v%d" % (h, h))
+        proposer = state.validators.get_proposer()
+        block = bx.create_proposal_block(h, state, last_commit, proposer.address)
+        bid, commit = _commit_for(state, block, privs)
+        state, _ = bx.apply_block(state, bid, block)
+        assert state.last_block_height == h
+        assert mp.size() == 0  # committed tx removed
+        last_commit = commit
+
+    assert app.size == 3
+    assert state.app_hash == (3).to_bytes(8, "big")
+    # validator history is queryable per height
+    assert store.load_validators(2).hash() == store.load_validators(3).hash()
+    resp = store.load_abci_responses(2)
+    assert len(resp.deliver_txs) == 1 and resp.deliver_txs[0].code == 0
+    # reload state from disk
+    assert store.load().last_block_height == 3
+
+
+def test_block_store_roundtrip():
+    gd, privs = _genesis(1)
+    state = make_genesis_state(gd)
+    app = KVStoreApplication()
+    ss = StateStore(MemDB())
+    ss.save(state)
+    bx = BlockExecutor(ss, app, mempool=Mempool(app))
+    bs = BlockStore(MemDB())
+
+    last_commit = Commit(height=0, round=0, block_id=BlockID(), signatures=[])
+    block = bx.create_proposal_block(1, state, last_commit,
+                                     state.validators.get_proposer().address,
+                                     block_time=Time(1700000100, 0))
+    ps = PartSet.from_data(block.marshal())
+    bid, commit = _commit_for(state, block, privs)
+    bs.save_block(block, ps, commit)
+
+    assert bs.height == 1 and bs.base == 1
+    loaded = bs.load_block(1)
+    assert loaded.hash() == block.hash()
+    assert bs.load_block_by_hash(block.hash()).header.height == 1
+    assert bs.load_seen_commit(1).block_id == bid
+    meta = bs.load_block_meta(1)
+    assert meta.block_id.hash == block.hash()
+    part = bs.load_block_part(1, 0)
+    assert part.bytes_ == ps.get_part(0).bytes_
+
+
+def test_file_pv_double_sign_protection(tmp_path):
+    kf, sf = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    pv = FilePV.generate(kf, sf, seed=b"\x21" * 32)
+    bid = BlockID(hash=b"\xcc" * 32)
+    from tendermint_tpu.types.block_id import PartSetHeader
+
+    bid = BlockID(hash=b"\xcc" * 32, part_set_header=PartSetHeader(1, b"\xdd" * 32))
+
+    v = Vote(type=PREVOTE_TYPE, height=5, round=0, block_id=bid,
+             timestamp=Time(1700000000, 0), validator_address=pv.get_address(),
+             validator_index=0)
+    pv.sign_vote("pv-chain", v)
+    sig1 = v.signature
+
+    # same vote, later timestamp -> reuses previous timestamp + signature
+    v2 = Vote(type=PREVOTE_TYPE, height=5, round=0, block_id=bid,
+              timestamp=Time(1700000009, 0), validator_address=pv.get_address(),
+              validator_index=0)
+    pv.sign_vote("pv-chain", v2)
+    assert v2.signature == sig1 and v2.timestamp == Time(1700000000, 0)
+
+    # DIFFERENT block at same HRS -> refuses
+    v3 = Vote(type=PREVOTE_TYPE, height=5, round=0, block_id=BlockID(),
+              timestamp=Time(1700000000, 0), validator_address=pv.get_address(),
+              validator_index=0)
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote("pv-chain", v3)
+
+    # height regression after reload -> refuses
+    pv2 = FilePV.load(kf, sf)
+    v4 = Vote(type=PREVOTE_TYPE, height=4, round=0, block_id=bid,
+              timestamp=Time(1700000000, 0), validator_address=pv.get_address(),
+              validator_index=0)
+    with pytest.raises(DoubleSignError):
+        pv2.sign_vote("pv-chain", v4)
+
+
+def test_mempool_fifo_and_cache():
+    app = KVStoreApplication()
+    mp = Mempool(app, max_txs=3)
+    mp.check_tx(b"a=1")
+    mp.check_tx(b"b=2")
+    with pytest.raises(ErrTxInCache):
+        mp.check_tx(b"a=1")
+    assert mp.size() == 2
+    assert mp.reap_max_bytes_max_gas(1000, -1) == [b"a=1", b"b=2"]
+    # max_bytes limits the reap
+    assert len(mp.reap_max_bytes_max_gas(6, -1)) == 1
+    mp.lock()
+    mp.update(1, [b"a=1"], [abci.ResponseDeliverTx(code=0)])
+    mp.unlock()
+    assert mp.size() == 1
+    # committed tx stays cached -> rejected on re-add
+    with pytest.raises(ErrTxInCache):
+        mp.check_tx(b"a=1")
+
+
+def test_mempool_priority_ordering():
+    class PrioApp(KVStoreApplication):
+        def check_tx(self, req):
+            return abci.ResponseCheckTx(code=0, priority=len(req.tx))
+
+    mp = Mempool(PrioApp(), version="v1")
+    mp.check_tx(b"s")
+    mp.check_tx(b"looooong")
+    mp.check_tx(b"mid")
+    assert mp.reap_max_txs(-1) == [b"looooong", b"mid", b"s"]
+    # gossip iteration stays insertion-ordered
+    assert [m.tx for m in mp.iter_txs()] == [b"s", b"looooong", b"mid"]
+
+
+def test_state_store_abci_responses_roundtrip():
+    ss = StateStore(MemDB())
+    rs = ABCIResponses(deliver_txs=[
+        abci.ResponseDeliverTx(code=0, data=b"ok", gas_wanted=5),
+        abci.ResponseDeliverTx(code=7, log="fail"),
+    ])
+    ss.save_abci_responses(9, rs)
+    out = ss.load_abci_responses(9)
+    assert out.deliver_txs[0].data == b"ok"
+    assert out.deliver_txs[1].code == 7
