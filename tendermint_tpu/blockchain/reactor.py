@@ -1,0 +1,258 @@
+"""Fast sync: block pool + sync loop (reference: blockchain/v0/pool.go,
+blockchain/v0/reactor.go:309-419; channel 0x40;
+proto/tendermint/blockchain/types.proto).
+
+The hot loop verifies each fetched block with the NEXT block's LastCommit
+via VerifyCommitLight (reference: reactor.go:366) - on TPU one batched
+kernel call per block (and batchable across blocks).
+
+Messages: BlockRequest=1{height}, NoBlockResponse=2{height},
+BlockResponse=3{block}, StatusRequest=4{}, StatusResponse=5{height, base}.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Peer, Reactor
+from tendermint_tpu.types.block import Block
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.part_set import PartSet
+
+BLOCKCHAIN_CHANNEL = 0x40
+TRY_SYNC_INTERVAL_S = 0.01
+STATUS_UPDATE_INTERVAL_S = 10.0
+SWITCH_TO_CONSENSUS_INTERVAL_S = 1.0
+REQUEST_WINDOW = 16
+
+
+def msg_block_request(height: int) -> bytes:
+    return proto.Writer().message(1, proto.Writer().varint(1, height).out(), always=True).out()
+
+
+def msg_no_block_response(height: int) -> bytes:
+    return proto.Writer().message(2, proto.Writer().varint(1, height).out(), always=True).out()
+
+
+def msg_block_response(block: Block) -> bytes:
+    inner = proto.Writer().message(1, block.marshal(), always=True).out()
+    return proto.Writer().message(3, inner, always=True).out()
+
+
+def msg_status_request() -> bytes:
+    return proto.Writer().message(4, b"", always=True).out()
+
+
+def msg_status_response(height: int, base: int) -> bytes:
+    return proto.Writer().message(
+        5, proto.Writer().varint(1, height).varint(2, base).out(), always=True
+    ).out()
+
+
+class BlockPool:
+    """reference: blockchain/v0/pool.go."""
+
+    def __init__(self, start_height: int):
+        self.height = start_height  # next height to sync
+        self.peers: dict[str, tuple[int, int]] = {}  # id -> (base, height)
+        self.blocks: dict[int, tuple[Block, str]] = {}  # height -> (block, peer)
+        self.requested: dict[int, str] = {}
+        self._mtx = threading.RLock()
+
+    def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
+        with self._mtx:
+            self.peers[peer_id] = (base, height)
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._mtx:
+            self.peers.pop(peer_id, None)
+            for h in [h for h, p in self.requested.items() if p == peer_id]:
+                del self.requested[h]
+            for h in [h for h, (_, p) in self.blocks.items() if p == peer_id]:
+                del self.blocks[h]
+
+    def max_peer_height(self) -> int:
+        with self._mtx:
+            return max((h for _, h in self.peers.values()), default=0)
+
+    def is_caught_up(self) -> bool:
+        with self._mtx:
+            if not self.peers:
+                return False
+            return self.height >= self.max_peer_height()
+
+    def add_block(self, peer_id: str, block: Block) -> None:
+        with self._mtx:
+            h = block.header.height
+            if h < self.height or h in self.blocks:
+                return
+            self.blocks[h] = (block, peer_id)
+            self.requested.pop(h, None)
+
+    def peek_two_blocks(self) -> tuple[Block | None, Block | None]:
+        with self._mtx:
+            first = self.blocks.get(self.height, (None, None))[0]
+            second = self.blocks.get(self.height + 1, (None, None))[0]
+            return first, second
+
+    def pop_request(self) -> None:
+        with self._mtx:
+            self.blocks.pop(self.height, None)
+            self.height += 1
+
+    def redo_request(self, height: int) -> str | None:
+        """Invalid block: drop it + the peer that sent it."""
+        with self._mtx:
+            bad_peer = None
+            if height in self.blocks:
+                bad_peer = self.blocks[height][1]
+            for h in [h for h, (_, p) in self.blocks.items() if p == bad_peer]:
+                del self.blocks[h]
+            return bad_peer
+
+    def wanted_requests(self) -> list[tuple[int, str]]:
+        """Pick heights to request and a peer for each."""
+        with self._mtx:
+            out = []
+            for h in range(self.height, self.height + REQUEST_WINDOW):
+                if h in self.blocks or h in self.requested:
+                    continue
+                candidates = [pid for pid, (b, ph) in self.peers.items()
+                              if b <= h <= ph]
+                if not candidates:
+                    continue
+                pid = candidates[h % len(candidates)]
+                self.requested[h] = pid
+                out.append((h, pid))
+            return out
+
+
+class BlockchainReactor(Reactor):
+    def __init__(self, state, block_exec, block_store, fast_sync: bool,
+                 consensus_reactor=None, logger=None):
+        super().__init__("BLOCKCHAIN")
+        self.initial_state = state
+        self.state = state
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.fast_sync = fast_sync
+        self.consensus_reactor = consensus_reactor
+        self.logger = logger
+        self.pool = BlockPool(block_store.height + 1)
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._synced = threading.Event()
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(BLOCKCHAIN_CHANNEL, priority=10,
+                                  recv_message_capacity=50 * 1024 * 1024)]
+
+    # --- peer lifecycle ----------------------------------------------------
+
+    def add_peer(self, peer: Peer) -> None:
+        peer.try_send(BLOCKCHAIN_CHANNEL,
+                      msg_status_response(self.block_store.height, self.block_store.base))
+        peer.try_send(BLOCKCHAIN_CHANNEL, msg_status_request())
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        self.pool.remove_peer(peer.id)
+
+    # --- receive -----------------------------------------------------------
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        f = proto.fields(msg_bytes)
+        if 1 in f:  # BlockRequest
+            m = proto.fields(f[1][-1])
+            height = proto.as_sint64(m.get(1, [0])[-1])
+            block = self.block_store.load_block(height)
+            if block is not None:
+                peer.try_send(BLOCKCHAIN_CHANNEL, msg_block_response(block))
+            else:
+                peer.try_send(BLOCKCHAIN_CHANNEL, msg_no_block_response(height))
+        elif 3 in f:  # BlockResponse
+            m = proto.fields(f[3][-1])
+            block = Block.unmarshal(m.get(1, [b""])[-1])
+            self.pool.add_block(peer.id, block)
+        elif 4 in f:  # StatusRequest
+            peer.try_send(BLOCKCHAIN_CHANNEL,
+                          msg_status_response(self.block_store.height, self.block_store.base))
+        elif 5 in f:  # StatusResponse
+            m = proto.fields(f[5][-1])
+            height = proto.as_sint64(m.get(1, [0])[-1])
+            base = proto.as_sint64(m.get(2, [0])[-1])
+            self.pool.set_peer_range(peer.id, base, height)
+
+    # --- sync loop (reference: blockchain/v0/reactor.go:309-419) -----------
+
+    def start_sync(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._pool_routine, daemon=True)
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        self._running = False
+
+    def wait_until_synced(self, timeout: float) -> bool:
+        return self._synced.wait(timeout)
+
+    def _pool_routine(self) -> None:
+        last_status = 0.0
+        last_switch_check = 0.0
+        started_at = time.monotonic()
+        while self._running:
+            now = time.monotonic()
+            if now - last_status > STATUS_UPDATE_INTERVAL_S:
+                if self.switch is not None:
+                    self.switch.broadcast(BLOCKCHAIN_CHANNEL, msg_status_request())
+                last_status = now
+            # issue requests
+            if self.switch is not None:
+                with self.switch._peers_mtx:
+                    peers = dict(self.switch.peers)
+                for h, pid in self.pool.wanted_requests():
+                    p = peers.get(pid)
+                    if p is not None:
+                        p.try_send(BLOCKCHAIN_CHANNEL, msg_block_request(h))
+            # switch to consensus when caught up
+            if now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL_S:
+                last_switch_check = now
+                caught_up = self.pool.is_caught_up()
+                waited_enough = now - started_at > 3.0
+                no_peers = self.switch is None or not self.switch.peers
+                if caught_up or (waited_enough and no_peers):
+                    self._running = False
+                    self._synced.set()
+                    if self.consensus_reactor is not None:
+                        self.consensus_reactor.switch_to_consensus(self.state)
+                    return
+            self._try_sync()
+            time.sleep(TRY_SYNC_INTERVAL_S)
+
+    def _try_sync(self) -> None:
+        first, second = self.pool.peek_two_blocks()
+        if first is None or second is None:
+            return
+        first_parts = PartSet.from_data(first.marshal())
+        first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header())
+        try:
+            # verify first block using second's LastCommit (reference:
+            # reactor.go:366 VerifyCommitLight -> ONE batched kernel call)
+            if second.last_commit is None:
+                raise ValueError("second block has no LastCommit")
+            if second.last_commit.block_id != first_id:
+                raise ValueError("second block's LastCommit is for a different block")
+            self.state.validators.verify_commit_light(
+                self.state.chain_id, first_id, first.header.height, second.last_commit
+            )
+        except Exception as e:  # noqa: BLE001
+            bad = self.pool.redo_request(first.header.height)
+            if self.switch is not None and bad in self.switch.peers:
+                self.switch.stop_peer_for_error(self.switch.peers[bad],
+                                                f"invalid block: {e}")
+            return
+        self.pool.pop_request()
+        self.block_store.save_block(first, first_parts, second.last_commit)
+        self.state, _ = self.block_exec.apply_block(self.state, first_id, first)

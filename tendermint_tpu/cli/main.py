@@ -1,0 +1,272 @@
+"""Operator CLI (reference: cmd/tendermint/commands/): init, start, testnet,
+show-node-id, show-validator, gen-validator, gen-node-key, unsafe-reset-all,
+rollback, replay, version.
+
+Usage: python -m tendermint_tpu.cli <command> [--home DIR] [options]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+
+from tendermint_tpu.config.config import Config, default_config
+
+
+def _home(args) -> str:
+    return os.path.abspath(args.home or os.environ.get("TMTPU_HOME", os.path.expanduser("~/.tendermint-tpu")))
+
+
+def _ensure_dirs(root: str) -> None:
+    for d in ("config", "data"):
+        os.makedirs(os.path.join(root, d), exist_ok=True)
+
+
+def _load_config(root: str) -> Config:
+    cfg = default_config().set_root(root)
+    toml_path = os.path.join(root, "config", "config.toml")
+    if os.path.exists(toml_path):
+        from tendermint_tpu.config.toml import load_toml_into
+
+        load_toml_into(cfg, toml_path)
+    cfg.base.root_dir = root
+    return cfg
+
+
+def cmd_init(args) -> int:
+    """reference: cmd/tendermint/commands/init.go."""
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types.ttime import Time
+
+    root = _home(args)
+    _ensure_dirs(root)
+    cfg = default_config().set_root(root)
+
+    pv = FilePV.load_or_generate(cfg.priv_validator_key_file(),
+                                 cfg.priv_validator_state_file())
+    NodeKey.load_or_gen(cfg.node_key_file())
+
+    gen_file = cfg.genesis_file()
+    if os.path.exists(gen_file):
+        print(f"Found genesis file {gen_file}")
+    else:
+        chain_id = args.chain_id or f"test-chain-{os.urandom(3).hex()}"
+        doc = GenesisDoc(
+            chain_id=chain_id,
+            genesis_time=Time.now(),
+            validators=[GenesisValidator(b"", pv.get_pub_key(), 10)],
+        )
+        doc.validate_and_complete()
+        doc.save_as(gen_file)
+        print(f"Generated genesis file {gen_file}")
+
+    from tendermint_tpu.config.toml import write_config_toml
+
+    toml_path = os.path.join(root, "config", "config.toml")
+    if not os.path.exists(toml_path):
+        write_config_toml(cfg, toml_path)
+        print(f"Generated config file {toml_path}")
+    return 0
+
+
+def cmd_start(args) -> int:
+    """reference: cmd/tendermint/commands/run_node.go."""
+    from tendermint_tpu.node.node import Node
+
+    root = _home(args)
+    cfg = _load_config(root)
+    if args.proxy_app:
+        cfg.base.proxy_app = args.proxy_app
+    if args.p2p_laddr:
+        cfg.p2p.laddr = args.p2p_laddr
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    if args.persistent_peers:
+        cfg.p2p.persistent_peers = args.persistent_peers
+
+    node = Node(cfg)
+    node.start()
+    print(f"Started node {node.node_key.id()} p2p={node.transport.node_info.listen_addr}")
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        node.stop()
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from tendermint_tpu.p2p.key import NodeKey
+
+    cfg = _load_config(_home(args))
+    print(NodeKey.load(cfg.node_key_file()).id())
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    import base64
+
+    from tendermint_tpu.privval.file_pv import FilePV
+
+    cfg = _load_config(_home(args))
+    pv = FilePV.load(cfg.priv_validator_key_file(), cfg.priv_validator_state_file())
+    pub = pv.get_pub_key()
+    print(json.dumps({"type": "tendermint/PubKeyEd25519",
+                      "value": base64.b64encode(pub.bytes()).decode()}))
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    import base64
+
+    from tendermint_tpu.crypto import ed25519
+
+    priv = ed25519.gen_priv_key()
+    print(json.dumps({
+        "address": priv.pub_key().address().hex().upper(),
+        "pub_key": {"type": "tendermint/PubKeyEd25519",
+                    "value": base64.b64encode(priv.pub_key().bytes()).decode()},
+        "priv_key": {"type": "tendermint/PrivKeyEd25519",
+                     "value": base64.b64encode(priv.bytes()).decode()},
+    }, indent=2))
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    from tendermint_tpu.p2p.key import NodeKey
+
+    cfg = _load_config(_home(args))
+    nk = NodeKey.load_or_gen(cfg.node_key_file())
+    print(nk.id())
+    return 0
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """reference: cmd/tendermint/commands/reset.go."""
+    root = _home(args)
+    data = os.path.join(root, "data")
+    if os.path.isdir(data):
+        shutil.rmtree(data)
+        os.makedirs(data)
+    # keep the validator key; reset sign state
+    from tendermint_tpu.privval.file_pv import FilePV
+
+    cfg = default_config().set_root(root)
+    if os.path.exists(cfg.priv_validator_key_file()):
+        pv = FilePV.load(cfg.priv_validator_key_file(), cfg.priv_validator_state_file())
+        pv.last_sign_state.save()
+    print(f"Reset {data}")
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """Generate a v-node localnet layout (reference:
+    cmd/tendermint/commands/testnet.go)."""
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types.ttime import Time
+    from tendermint_tpu.config.toml import write_config_toml
+
+    out = os.path.abspath(args.output)
+    n = args.v
+    pvs = []
+    node_keys = []
+    for i in range(n):
+        root = os.path.join(out, f"node{i}")
+        _ensure_dirs(root)
+        cfg = default_config().set_root(root)
+        pvs.append(FilePV.load_or_generate(cfg.priv_validator_key_file(),
+                                           cfg.priv_validator_state_file()))
+        node_keys.append(NodeKey.load_or_gen(cfg.node_key_file()))
+
+    doc = GenesisDoc(
+        chain_id=args.chain_id or f"testnet-{os.urandom(3).hex()}",
+        genesis_time=Time.now(),
+        validators=[GenesisValidator(b"", pv.get_pub_key(), 1) for pv in pvs],
+    )
+    doc.validate_and_complete()
+
+    peers = ",".join(
+        f"{node_keys[i].id()}@127.0.0.1:{args.starting_port + 2 * i}" for i in range(n)
+    )
+    for i in range(n):
+        root = os.path.join(out, f"node{i}")
+        cfg = default_config().set_root(root)
+        doc.save_as(cfg.genesis_file())
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{args.starting_port + 2 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{args.starting_port + 2 * i + 1}"
+        cfg.p2p.persistent_peers = peers
+        write_config_toml(cfg, os.path.join(root, "config", "config.toml"))
+    print(f"Successfully initialized {n} node directories in {out}")
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    """Undo one height (reference: cmd/tendermint/commands/rollback.go,
+    state/rollback.go:112)."""
+    from tendermint_tpu.state.rollback import rollback_state
+
+    cfg = _load_config(_home(args))
+    height, app_hash = rollback_state(cfg)
+    print(f"Rolled back state to height {height} and hash {app_hash.hex().upper()}")
+    return 0
+
+
+def cmd_version(args) -> int:
+    print("0.34.24-tpu")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tendermint-tpu")
+    p.add_argument("--home", default=None, help="node home directory")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("init", help="initialize a node")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("start", help="run the node")
+    sp.add_argument("--proxy_app", default="")
+    sp.add_argument("--p2p.laddr", dest="p2p_laddr", default="")
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
+    sp.add_argument("--p2p.persistent_peers", dest="persistent_peers", default="")
+    sp.set_defaults(fn=cmd_start)
+
+    for name, fn in (("show-node-id", cmd_show_node_id),
+                     ("show-validator", cmd_show_validator),
+                     ("gen-validator", cmd_gen_validator),
+                     ("gen-node-key", cmd_gen_node_key),
+                     ("unsafe-reset-all", cmd_unsafe_reset_all),
+                     ("rollback", cmd_rollback),
+                     ("version", cmd_version)):
+        sp = sub.add_parser(name)
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("testnet", help="generate a localnet")
+    sp.add_argument("--v", type=int, default=4)
+    sp.add_argument("--output", "-o", default="./mytestnet")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--starting-port", type=int, default=26656)
+    sp.set_defaults(fn=cmd_testnet)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

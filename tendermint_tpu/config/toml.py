@@ -1,0 +1,66 @@
+"""Config TOML rendering + loading (reference: config/toml.go).
+
+Writing uses a template mirroring the reference's section layout; reading
+uses stdlib tomllib.
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import fields as dc_fields
+
+from tendermint_tpu.config.config import Config
+
+
+def _toml_value(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, (tuple, list)):
+        return "[" + ", ".join(_toml_value(x) for x in v) + "]"
+    return '"' + str(v).replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+_SECTIONS = [
+    ("", "base"),
+    ("rpc", "rpc"),
+    ("p2p", "p2p"),
+    ("mempool", "mempool"),
+    ("statesync", "statesync"),
+    ("fastsync", "fastsync"),
+    ("consensus", "consensus"),
+    ("storage", "storage"),
+    ("tx_index", "tx_index"),
+    ("instrumentation", "instrumentation"),
+]
+
+
+def write_config_toml(cfg: Config, path: str) -> None:
+    lines = ["# tendermint-tpu node configuration", ""]
+    for section, attr in _SECTIONS:
+        obj = getattr(cfg, attr)
+        if section:
+            lines.append(f"[{section}]")
+        for f in dc_fields(obj):
+            if f.name == "root_dir":
+                continue
+            lines.append(f"{f.name} = {_toml_value(getattr(obj, f.name))}")
+        lines.append("")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines))
+
+
+def load_toml_into(cfg: Config, path: str) -> Config:
+    with open(path, "rb") as fh:
+        doc = tomllib.load(fh)
+    for section, attr in _SECTIONS:
+        obj = getattr(cfg, attr)
+        src = doc if section == "" else doc.get(section, {})
+        for f in dc_fields(obj):
+            if f.name in src and f.name != "root_dir":
+                val = src[f.name]
+                if isinstance(getattr(obj, f.name), tuple) and isinstance(val, list):
+                    val = tuple(val)
+                setattr(obj, f.name, val)
+    return cfg

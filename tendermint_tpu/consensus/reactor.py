@@ -1,0 +1,552 @@
+"""Consensus reactor: gossips round state, proposals, block parts, and votes
+(reference: consensus/reactor.go:142 channels, :199-201 per-peer gossip
+routines, :1065+ PeerState).
+
+Channels (priorities as in reference GetChannels):
+  State 0x20 (prio 6), Data 0x21 (10), Vote 0x22 (7), VoteSetBits 0x23 (1).
+
+Wire: tendermint.consensus.Message oneof (proto/tendermint/consensus/types.proto).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tendermint_tpu.consensus import cstypes
+from tendermint_tpu.consensus.state_machine import ConsensusState
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Peer, Reactor
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.part_set import Part, PartSet
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE, Vote
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+
+# --- bit array wire helpers (proto/tendermint/libs/bits/types.proto) --------
+
+
+def bits_marshal(bits: list[bool]) -> bytes:
+    elems = []
+    for i in range(0, len(bits), 64):
+        word = 0
+        for j, b in enumerate(bits[i : i + 64]):
+            if b:
+                word |= 1 << j
+        elems.append(word)
+    w = proto.Writer().varint(1, len(bits))
+    w.packed_varints(2, elems)
+    return w.out()
+
+
+def bits_unmarshal(buf: bytes) -> list[bool]:
+    f = proto.fields(buf)
+    n = proto.as_sint64(f.get(1, [0])[-1])
+    elems = []
+    for raw in f.get(2, []):
+        if isinstance(raw, bytes):  # packed
+            pos = 0
+            while pos < len(raw):
+                v, pos = proto.decode_uvarint(raw, pos)
+                elems.append(v)
+        else:
+            elems.append(raw)
+    out = []
+    for i in range(n):
+        word = elems[i // 64] if i // 64 < len(elems) else 0
+        out.append(bool((word >> (i % 64)) & 1))
+    return out
+
+
+# --- message codecs ----------------------------------------------------------
+
+
+def _wrap(field_num: int, body: bytes) -> bytes:
+    return proto.Writer().message(field_num, body, always=True).out()
+
+
+def msg_new_round_step(height, round_, step, secs_since_start, last_commit_round) -> bytes:
+    return _wrap(1, proto.Writer().varint(1, height).varint(2, round_)
+                 .uvarint(3, step).varint(4, secs_since_start)
+                 .varint(5, last_commit_round).out())
+
+
+def msg_new_valid_block(height, round_, psh: PartSetHeader, parts_bits, is_commit) -> bytes:
+    return _wrap(2, proto.Writer().varint(1, height).varint(2, round_)
+                 .message(3, psh.marshal(), always=True)
+                 .message(4, bits_marshal(parts_bits))
+                 .bool(5, is_commit).out())
+
+
+def msg_proposal(p: Proposal) -> bytes:
+    return _wrap(3, proto.Writer().message(1, p.marshal(), always=True).out())
+
+
+def msg_block_part(height, round_, part: Part) -> bytes:
+    return _wrap(5, proto.Writer().varint(1, height).varint(2, round_)
+                 .message(3, part.marshal(), always=True).out())
+
+
+def msg_vote(v: Vote) -> bytes:
+    return _wrap(6, proto.Writer().message(1, v.marshal(), always=True).out())
+
+
+def msg_has_vote(height, round_, type_, index) -> bytes:
+    return _wrap(7, proto.Writer().varint(1, height).varint(2, round_)
+                 .varint(3, type_).varint(4, index).out())
+
+
+def msg_vote_set_maj23(height, round_, type_, block_id: BlockID) -> bytes:
+    return _wrap(8, proto.Writer().varint(1, height).varint(2, round_)
+                 .varint(3, type_).message(4, block_id.marshal(), always=True).out())
+
+
+def msg_vote_set_bits(height, round_, type_, block_id: BlockID, votes_bits) -> bytes:
+    return _wrap(9, proto.Writer().varint(1, height).varint(2, round_)
+                 .varint(3, type_).message(4, block_id.marshal(), always=True)
+                 .message(5, bits_marshal(votes_bits), always=True).out())
+
+
+# --- per-peer state (reference: consensus/reactor.go:1065 PeerState) --------
+
+
+@dataclass
+class PeerRoundState:
+    height: int = 0
+    round: int = -1
+    step: int = 0
+    proposal: bool = False
+    proposal_block_psh: PartSetHeader | None = None
+    proposal_block_parts: list[bool] = field(default_factory=list)
+    proposal_pol_round: int = -1
+    prevotes: dict[int, list[bool]] = field(default_factory=dict)      # round -> bits
+    precommits: dict[int, list[bool]] = field(default_factory=dict)
+    last_commit_round: int = -1
+    last_commit: list[bool] = field(default_factory=list)
+    catchup_commit_round: int = -1
+    catchup_commit: list[bool] = field(default_factory=list)
+
+
+class PeerState:
+    def __init__(self, peer: Peer):
+        self.peer = peer
+        self.prs = PeerRoundState()
+        self.mtx = threading.RLock()
+        self.running = True
+
+    def apply_new_round_step(self, height, round_, step, last_commit_round, n_vals) -> None:
+        with self.mtx:
+            prs = self.prs
+            init_height = prs.height
+            if prs.height != height or prs.round != round_:
+                prs.proposal = False
+                prs.proposal_block_psh = None
+                prs.proposal_block_parts = []
+                prs.proposal_pol_round = -1
+            if prs.height != height:
+                if prs.height + 1 == height and prs.round == last_commit_round:
+                    prs.last_commit_round = last_commit_round
+                    prs.last_commit = prs.precommits.get(last_commit_round, [])
+                else:
+                    prs.last_commit_round = last_commit_round
+                    prs.last_commit = []
+                prs.prevotes = {}
+                prs.precommits = {}
+                prs.catchup_commit_round = -1
+                prs.catchup_commit = []
+            prs.height = height
+            prs.round = round_
+            prs.step = step
+            _ = init_height
+
+    def set_has_proposal(self, proposal: Proposal) -> None:
+        with self.mtx:
+            prs = self.prs
+            if prs.height != proposal.height or prs.round != proposal.round:
+                return
+            if prs.proposal:
+                return
+            prs.proposal = True
+            if not prs.proposal_block_parts:  # otherwise NewValidBlock set it
+                prs.proposal_block_psh = proposal.block_id.part_set_header
+                prs.proposal_block_parts = [False] * proposal.block_id.part_set_header.total
+            prs.proposal_pol_round = proposal.pol_round
+
+    def set_has_block_part(self, height, round_, index) -> None:
+        with self.mtx:
+            prs = self.prs
+            if prs.height != height or prs.round != round_:
+                return
+            if 0 <= index < len(prs.proposal_block_parts):
+                prs.proposal_block_parts[index] = True
+
+    def set_has_vote(self, height, round_, type_, index, n_vals) -> None:
+        with self.mtx:
+            bits = self._votes_bits(height, round_, type_, n_vals)
+            if bits is not None and 0 <= index < len(bits):
+                bits[index] = True
+
+    def _votes_bits(self, height, round_, type_, n_vals) -> list[bool] | None:
+        prs = self.prs
+        if prs.height == height:
+            table = prs.prevotes if type_ == PREVOTE_TYPE else prs.precommits
+            if round_ not in table and round_ in (prs.round, prs.round + 1,
+                                                 prs.catchup_commit_round):
+                table[round_] = [False] * n_vals
+            return table.get(round_)
+        if prs.height == height + 1 and type_ == PRECOMMIT_TYPE and round_ == prs.last_commit_round:
+            if not prs.last_commit:
+                prs.last_commit = [False] * n_vals
+            return prs.last_commit
+        return None
+
+
+# --- the reactor -------------------------------------------------------------
+
+
+class ConsensusReactor(Reactor):
+    def __init__(self, cs: ConsensusState, wait_sync: bool = False):
+        super().__init__("CONSENSUS")
+        self.cs = cs
+        self.wait_sync = wait_sync  # True while fast sync is running
+        self._peer_states: dict[str, PeerState] = {}
+        self._mtx = threading.RLock()
+        cs.on_new_round_step.append(self._broadcast_new_round_step)
+        cs.on_vote.append(self._broadcast_has_vote)
+        cs.on_valid_block.append(self._broadcast_new_valid_block)
+        cs.broadcast = self._cs_broadcast
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        """reference: consensus/reactor.go:142-178."""
+        return [
+            ChannelDescriptor(STATE_CHANNEL, priority=6),
+            ChannelDescriptor(DATA_CHANNEL, priority=10),
+            ChannelDescriptor(VOTE_CHANNEL, priority=7),
+            ChannelDescriptor(VOTE_SET_BITS_CHANNEL, priority=1),
+        ]
+
+    def switch_to_consensus(self, state, skip_wal: bool = False) -> None:
+        """Called by the fast-sync reactor when caught up (reference:
+        consensus/reactor.go:108-140)."""
+        if state.last_block_height > self.cs.state.last_block_height:
+            self.cs.update_to_state(state)
+        self.wait_sync = False
+        self.cs.start()
+
+    # --- peer lifecycle ----------------------------------------------------
+
+    def add_peer(self, peer: Peer) -> None:
+        ps = PeerState(peer)
+        with self._mtx:
+            self._peer_states[peer.id] = ps
+        peer.set("consensus_peer_state", ps)
+        for target in (self._gossip_data_routine, self._gossip_votes_routine,
+                       self._query_maj23_routine):
+            threading.Thread(target=target, args=(peer, ps), daemon=True).start()
+        if not self.wait_sync:
+            self._send_new_round_step(peer)
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        with self._mtx:
+            ps = self._peer_states.pop(peer.id, None)
+        if ps is not None:
+            ps.running = False
+
+    # --- receive -----------------------------------------------------------
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        ps: PeerState = peer.get("consensus_peer_state")
+        if ps is None:
+            return
+        f = proto.fields(msg_bytes)
+        n_vals = self.cs.rs.validators.size() if self.cs.rs.validators else 0
+        if ch_id == STATE_CHANNEL:
+            if 1 in f:  # NewRoundStep
+                m = proto.fields(f[1][-1])
+                height = proto.as_sint64(m.get(1, [0])[-1])
+                round_ = proto.as_sint64(m.get(2, [0])[-1])
+                step = m.get(3, [0])[-1]
+                lcr = proto.as_sint64(m.get(5, [0])[-1])
+                ps.apply_new_round_step(height, round_, step, lcr, n_vals)
+            elif 2 in f:  # NewValidBlock
+                m = proto.fields(f[2][-1])
+                with ps.mtx:
+                    if ps.prs.height == proto.as_sint64(m.get(1, [0])[-1]):
+                        ps.prs.proposal_block_psh = PartSetHeader.unmarshal(m.get(3, [b""])[-1])
+                        ps.prs.proposal_block_parts = bits_unmarshal(m.get(4, [b""])[-1]) if 4 in m else []
+            elif 7 in f:  # HasVote
+                m = proto.fields(f[7][-1])
+                ps.set_has_vote(
+                    proto.as_sint64(m.get(1, [0])[-1]),
+                    proto.as_sint64(m.get(2, [0])[-1]),
+                    proto.as_sint64(m.get(3, [0])[-1]),
+                    proto.as_sint64(m.get(4, [0])[-1]),
+                    n_vals,
+                )
+            elif 8 in f:  # VoteSetMaj23
+                m = proto.fields(f[8][-1])
+                height = proto.as_sint64(m.get(1, [0])[-1])
+                round_ = proto.as_sint64(m.get(2, [0])[-1])
+                type_ = proto.as_sint64(m.get(3, [0])[-1])
+                bid = BlockID.unmarshal(m.get(4, [b""])[-1])
+                self._handle_vote_set_maj23(peer, ps, height, round_, type_, bid)
+        elif ch_id == DATA_CHANNEL:
+            if self.wait_sync:
+                return
+            if 3 in f:  # Proposal
+                m = proto.fields(f[3][-1])
+                p = Proposal.unmarshal(m.get(1, [b""])[-1])
+                ps.set_has_proposal(p)
+                self.cs.set_proposal(p, peer_id=peer.id)
+            elif 4 in f:  # ProposalPOL
+                m = proto.fields(f[4][-1])
+                with ps.mtx:
+                    if ps.prs.height == proto.as_sint64(m.get(1, [0])[-1]):
+                        ps.prs.proposal_pol_round = proto.as_sint64(m.get(2, [0])[-1])
+            elif 5 in f:  # BlockPart
+                m = proto.fields(f[5][-1])
+                height = proto.as_sint64(m.get(1, [0])[-1])
+                round_ = proto.as_sint64(m.get(2, [0])[-1])
+                part = Part.unmarshal(m.get(3, [b""])[-1])
+                ps.set_has_block_part(height, round_, part.index)
+                self.cs.add_proposal_block_part(height, round_, part, peer_id=peer.id)
+        elif ch_id == VOTE_CHANNEL:
+            if self.wait_sync:
+                return
+            if 6 in f:
+                m = proto.fields(f[6][-1])
+                vote = Vote.unmarshal(m.get(1, [b""])[-1])
+                ps.set_has_vote(vote.height, vote.round, vote.type,
+                                vote.validator_index, n_vals)
+                self.cs.add_vote(vote, peer_id=peer.id)
+        elif ch_id == VOTE_SET_BITS_CHANNEL:
+            if 9 in f:
+                m = proto.fields(f[9][-1])
+                # peer tells us which votes it has for a maj23
+                height = proto.as_sint64(m.get(1, [0])[-1])
+                round_ = proto.as_sint64(m.get(2, [0])[-1])
+                type_ = proto.as_sint64(m.get(3, [0])[-1])
+                bits = bits_unmarshal(m.get(5, [b""])[-1]) if 5 in m else []
+                with ps.mtx:
+                    table = ps.prs.prevotes if type_ == PREVOTE_TYPE else ps.prs.precommits
+                    if height == ps.prs.height:
+                        existing = table.get(round_)
+                        if existing is None:
+                            table[round_] = bits
+                        else:
+                            for i, b in enumerate(bits[: len(existing)]):
+                                existing[i] = existing[i] or b
+
+    def _handle_vote_set_maj23(self, peer, ps, height, round_, type_, bid) -> None:
+        """reference: consensus/reactor.go:300-340."""
+        rs = self.cs.rs
+        if rs.height != height or rs.votes is None:
+            return
+        try:
+            if type_ == PREVOTE_TYPE:
+                votes = rs.votes.prevotes(round_)
+            else:
+                votes = rs.votes.precommits(round_)
+            if votes is None:
+                return
+            votes.set_peer_maj23(peer.id, bid)
+            our_bits = votes.bit_array_by_block_id(bid) or []
+            peer.try_send(VOTE_SET_BITS_CHANNEL,
+                          msg_vote_set_bits(height, round_, type_, bid, our_bits))
+        except Exception:  # noqa: BLE001
+            pass
+
+    # --- broadcasts from our own state machine ------------------------------
+
+    def _cs_broadcast(self, msg) -> None:
+        """Internally-generated proposal/parts/votes: peers get them via the
+        gossip routines; nothing to do eagerly (reference relies on gossip).
+        Votes additionally trigger HasVote broadcasts via on_vote."""
+
+    def _broadcast_new_round_step(self, rs) -> None:
+        if self.switch is None:
+            return
+        self.switch.broadcast(STATE_CHANNEL, self._new_round_step_msg(rs))
+
+    def _broadcast_new_valid_block(self, rs) -> None:
+        if self.switch is None or rs.proposal_block_parts is None:
+            return
+        self.switch.broadcast(STATE_CHANNEL, msg_new_valid_block(
+            rs.height, rs.round, rs.proposal_block_parts.header(),
+            rs.proposal_block_parts.bit_array(), rs.step == cstypes.STEP_COMMIT))
+
+    def _broadcast_has_vote(self, vote: Vote) -> None:
+        if self.switch is None:
+            return
+        self.switch.broadcast(STATE_CHANNEL, msg_has_vote(
+            vote.height, vote.round, vote.type, vote.validator_index))
+
+    def _new_round_step_msg(self, rs) -> bytes:
+        import time as _t
+
+        secs = max(0, int(_t.time() - rs.start_time.seconds)) if rs.start_time else 0
+        lcr = rs.last_commit.round if rs.last_commit is not None else -1
+        return msg_new_round_step(rs.height, rs.round, rs.step, secs, lcr)
+
+    def _send_new_round_step(self, peer: Peer) -> None:
+        peer.try_send(STATE_CHANNEL, self._new_round_step_msg(self.cs.rs))
+
+    # --- gossip routines (reference: consensus/reactor.go:540-1050) --------
+
+    def _gossip_data_routine(self, peer: Peer, ps: PeerState) -> None:
+        while ps.running and self.switch is not None:
+            if self.wait_sync:
+                time.sleep(0.1)
+                continue
+            rs = self.cs.rs
+            prs = ps.prs
+            sent = False
+            # send block parts the peer lacks for the current proposal
+            if (rs.proposal_block_parts is not None and prs.height == rs.height
+                    and prs.proposal_block_psh == rs.proposal_block_parts.header()):
+                ours = rs.proposal_block_parts.bit_array()
+                theirs = prs.proposal_block_parts
+                want = [i for i, have in enumerate(ours)
+                        if have and (i >= len(theirs) or not theirs[i])]
+                if want:
+                    i = random.choice(want)
+                    part = rs.proposal_block_parts.get_part(i)
+                    if part is not None and peer.try_send(
+                            DATA_CHANNEL, msg_block_part(rs.height, rs.round, part)):
+                        ps.set_has_block_part(prs.height, prs.round, i)
+                        sent = True
+            # catchup: peer is on an older height -> send stored block parts
+            elif (0 < prs.height < rs.height
+                  and prs.height >= self.cs.block_store.base):
+                self._gossip_data_for_catchup(peer, ps)
+                sent = True
+            # send proposal
+            if (not sent and rs.proposal is not None and prs.height == rs.height
+                    and prs.round == rs.round and not prs.proposal):
+                if peer.try_send(DATA_CHANNEL, msg_proposal(rs.proposal)):
+                    ps.set_has_proposal(rs.proposal)
+                    sent = True
+            if not sent:
+                time.sleep(self.cs.config.peer_gossip_sleep_duration_s)
+
+    def _gossip_data_for_catchup(self, peer: Peer, ps: PeerState) -> None:
+        """reference: consensus/reactor.go:631-700."""
+        prs = ps.prs
+        meta = self.cs.block_store.load_block_meta(prs.height)
+        if meta is None:
+            time.sleep(self.cs.config.peer_gossip_sleep_duration_s)
+            return
+        with ps.mtx:
+            if prs.proposal_block_psh != meta.block_id.part_set_header:
+                prs.proposal_block_psh = meta.block_id.part_set_header
+                prs.proposal_block_parts = [False] * meta.block_id.part_set_header.total
+            want = [i for i, have in enumerate(prs.proposal_block_parts) if not have]
+        if not want:
+            time.sleep(self.cs.config.peer_gossip_sleep_duration_s)
+            return
+        i = random.choice(want)
+        part = self.cs.block_store.load_block_part(prs.height, i)
+        if part is None:
+            time.sleep(self.cs.config.peer_gossip_sleep_duration_s)
+            return
+        if peer.try_send(DATA_CHANNEL, msg_block_part(prs.height, prs.round, part)):
+            ps.set_has_block_part(prs.height, prs.round, i)
+
+    def _gossip_votes_routine(self, peer: Peer, ps: PeerState) -> None:
+        while ps.running and self.switch is not None:
+            if self.wait_sync:
+                time.sleep(0.1)
+                continue
+            rs = self.cs.rs
+            prs = ps.prs
+            if rs.votes is None:
+                time.sleep(0.05)
+                continue
+            if self._pick_send_vote(peer, ps, rs, prs):
+                continue
+            time.sleep(self.cs.config.peer_gossip_sleep_duration_s)
+
+    def _pick_send_vote(self, peer, ps, rs, prs) -> bool:
+        """Pick one vote the peer lacks and send it (reference:
+        consensus/reactor.go:716-830 gossipVotesRoutine + PickSendVote)."""
+        def send_from(vote_set, their_bits) -> bool:
+            if vote_set is None:
+                return False
+            for i, v in enumerate(vote_set.votes):
+                if v is None:
+                    continue
+                if their_bits is not None and i < len(their_bits) and their_bits[i]:
+                    continue
+                if peer.try_send(VOTE_CHANNEL, msg_vote(v)):
+                    ps.set_has_vote(v.height, v.round, v.type, i,
+                                    vote_set.val_set.size())
+                    return True
+                return False
+            return False
+
+        if prs.height == rs.height:
+            # current round prevotes/precommits + POL prevotes
+            if prs.proposal_pol_round >= 0:
+                pv = rs.votes.prevotes(prs.proposal_pol_round)
+                if send_from(pv, prs.prevotes.get(prs.proposal_pol_round)):
+                    return True
+            pv = rs.votes.prevotes(prs.round) if prs.round >= 0 else None
+            if send_from(pv, prs.prevotes.get(prs.round)):
+                return True
+            pc = rs.votes.precommits(prs.round) if prs.round >= 0 else None
+            if send_from(pc, prs.precommits.get(prs.round)):
+                return True
+        if prs.height + 1 == rs.height and rs.last_commit is not None:
+            # Peer is one height behind: send last-commit precommits. For the
+            # peer these are CURRENT-height precommits, so the have-bits live
+            # in prs.precommits[commit round] (reference: PeerState
+            # getVoteBitArray, consensus/reactor.go:1170-1210).
+            if send_from(rs.last_commit, prs.precommits.get(rs.last_commit.round)):
+                return True
+        if prs.height < rs.height and prs.height >= max(self.cs.block_store.base, 1):
+            # catchup: send precommits from the stored commit
+            commit = self.cs.block_store.load_block_commit(prs.height)
+            if commit is not None:
+                with ps.mtx:
+                    # EnsureCatchupCommitRound (reference: reactor.go:1120-1140)
+                    prs.catchup_commit_round = commit.round
+                their_bits = prs.precommits.get(commit.round)
+                for i, cs_sig in enumerate(commit.signatures):
+                    if cs_sig.absent():
+                        continue
+                    if their_bits and i < len(their_bits) and their_bits[i]:
+                        continue
+                    vote = commit.get_vote(i)
+                    if peer.try_send(VOTE_CHANNEL, msg_vote(vote)):
+                        ps.set_has_vote(vote.height, vote.round, vote.type, i,
+                                        len(commit.signatures))
+                        return True
+                    return False
+        return False
+
+    def _query_maj23_routine(self, peer: Peer, ps: PeerState) -> None:
+        """reference: consensus/reactor.go:870-950."""
+        while ps.running and self.switch is not None:
+            time.sleep(self.cs.config.peer_query_maj23_sleep_duration_s)
+            if self.wait_sync:
+                continue
+            rs = self.cs.rs
+            prs = ps.prs
+            if rs.votes is None or prs.height != rs.height:
+                continue
+            for type_, vs in ((PREVOTE_TYPE, rs.votes.prevotes(prs.round)),
+                              (PRECOMMIT_TYPE, rs.votes.precommits(prs.round))):
+                if vs is None:
+                    continue
+                maj, ok = vs.two_thirds_majority()
+                if ok:
+                    peer.try_send(STATE_CHANNEL,
+                                  msg_vote_set_maj23(rs.height, prs.round, type_, maj))

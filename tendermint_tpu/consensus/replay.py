@@ -1,0 +1,124 @@
+"""Handshaker: syncs the ABCI app with the block store on startup
+(reference: consensus/replay.go:241,284,437).
+
+On restart the app may be behind (crash between SaveBlock and Commit) or
+fresh (empty app behind a populated chain): replay stored blocks through the
+app until app height == store height.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.state import execution as sm_exec
+from tendermint_tpu.state.state import State
+from tendermint_tpu.state.store import ABCIResponses, StateStore
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.genesis import GenesisDoc
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class Handshaker:
+    def __init__(self, state_store: StateStore, block_store: BlockStore,
+                 genesis: GenesisDoc, logger=None):
+        self.state_store = state_store
+        self.block_store = block_store
+        self.genesis = genesis
+        self.logger = logger
+        self.n_blocks = 0
+
+    def handshake(self, state: State, app) -> State:
+        """reference: consensus/replay.go:241-284."""
+        res = app.info(abci.RequestInfo(version="0.34.24-tpu"))
+        app_height = res.last_block_height
+        app_hash = res.last_block_app_hash
+        if app_height < 0:
+            raise HandshakeError(f"got a negative last block height ({app_height}) from the app")
+        return self.replay_blocks(state, app, app_hash, app_height)
+
+    def replay_blocks(self, state: State, app, app_hash: bytes, app_height: int) -> State:
+        """reference: consensus/replay.go:284-437."""
+        store_height = self.block_store.height
+        state_height = state.last_block_height
+
+        # InitChain if the app is at height 0.
+        if app_height == 0:
+            validators = [
+                Validator.new(v.pub_key, v.power) for v in self.genesis.validators
+            ]
+            req = abci.RequestInitChain(
+                time_seconds=self.genesis.genesis_time.seconds,
+                time_nanos=self.genesis.genesis_time.nanos,
+                chain_id=self.genesis.chain_id,
+                consensus_params=self.genesis.consensus_params,
+                validators=[
+                    abci.ValidatorUpdate(v.pub_key.type, v.pub_key.bytes(), v.voting_power)
+                    for v in validators
+                ],
+                app_state_bytes=self.genesis.app_state,
+                initial_height=self.genesis.initial_height,
+            )
+            res = app.init_chain(req)
+            if store_height == 0:
+                # apply InitChain response to state (reference: replay.go:330-370)
+                if res.app_hash:
+                    state.app_hash = res.app_hash
+                    app_hash = res.app_hash
+                if res.validators:
+                    vals = sm_exec.validator_updates_from_abci(res.validators)
+                    state.validators = ValidatorSet(vals)
+                    state.next_validators = ValidatorSet(vals)
+                    state.next_validators.increment_proposer_priority(1)
+                elif not self.genesis.validators:
+                    raise HandshakeError("validator set is nil in genesis and still empty after InitChain")
+                if res.consensus_params is not None:
+                    state.consensus_params = res.consensus_params
+                self.state_store.save(state)
+
+        if store_height == 0:
+            return state
+
+        # replay any blocks the app is missing
+        if app_height < store_height:
+            state = self._replay_range(state, app, app_height, store_height)
+        elif app_height > store_height:
+            raise HandshakeError(
+                f"app block height ({app_height}) is higher than the chain ({store_height})"
+            )
+        return state
+
+    def _replay_range(self, state: State, app, app_height: int, store_height: int) -> State:
+        """Replay blocks [app_height+1, store_height] through the app
+        (reference: consensus/replay.go:437-530 replayBlocks/replayBlock)."""
+        first = max(app_height + 1, self.block_store.base)
+        for h in range(first, store_height + 1):
+            block = self.block_store.load_block(h)
+            if block is None:
+                raise HandshakeError(f"missing block at height {h} during replay")
+            meta = self.block_store.load_block_meta(h)
+            if state.last_block_height < h:
+                # full apply through BlockExecutor (also saves state)
+                bx = sm_exec.BlockExecutor(self.state_store, app,
+                                           block_store=self.block_store)
+                state, _ = bx.apply_block(state, meta.block_id, block)
+            else:
+                # state is ahead: app-only replay (exec + commit, no state save)
+                self._exec_block_app_only(state, app, block, meta.block_id)
+            self.n_blocks += 1
+        return state
+
+    def _exec_block_app_only(self, state: State, app, block, block_id: BlockID) -> None:
+        commit_info = sm_exec.get_begin_block_validator_info(
+            block, self.state_store, state.initial_height)
+        app.begin_block(abci.RequestBeginBlock(
+            hash=block.hash() or b"", header=block.header,
+            last_commit_info=commit_info))
+        for tx in block.data.txs:
+            app.deliver_tx(abci.RequestDeliverTx(tx=tx))
+        app.end_block(abci.RequestEndBlock(height=block.header.height))
+        app.commit()

@@ -1,0 +1,221 @@
+"""Evidence pool: detects, stores, and provides byzantine evidence
+(reference: evidence/pool.go, evidence/verify.go:19,113,162).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.store.db import DB
+from tendermint_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    EvidenceError,
+    LightClientAttackEvidence,
+    evidence_unmarshal,
+)
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.vote import Vote
+
+
+def _pending_key(ev) -> bytes:
+    return b"p%020d%s" % (ev.height(), ev.hash().hex().encode())
+
+
+def _committed_key(ev) -> bytes:
+    return b"c%020d%s" % (ev.height(), ev.hash().hex().encode())
+
+
+class EvidencePool:
+    def __init__(self, db: DB, state_store, block_store, logger=None):
+        self._db = db
+        self.state_store = state_store
+        self.block_store = block_store
+        self.logger = logger
+        self._mtx = threading.Lock()
+        # votes reported by consensus, to be turned into evidence
+        self._consensus_buffer: list[tuple[Vote, Vote]] = []
+        self.on_evidence = []  # callbacks(ev) for the reactor broadcast
+
+    # --- queries -----------------------------------------------------------
+
+    def pending_evidence(self, max_bytes: int) -> tuple[list, int]:
+        """reference: evidence/pool.go PendingEvidence."""
+        self._process_consensus_buffer()
+        out = []
+        size = 0
+        for _k, v in self._db.iterator(b"p", b"q"):
+            ev = evidence_unmarshal(v)
+            sz = len(v)
+            if max_bytes >= 0 and size + sz > max_bytes:
+                break
+            out.append(ev)
+            size += sz
+        return out, size
+
+    def is_pending(self, ev) -> bool:
+        return self._db.has(_pending_key(ev))
+
+    def is_committed(self, ev) -> bool:
+        return self._db.has(_committed_key(ev))
+
+    # --- adding ------------------------------------------------------------
+
+    def add_evidence(self, ev) -> None:
+        """reference: evidence/pool.go AddEvidence."""
+        with self._mtx:
+            if self.is_pending(ev) or self.is_committed(ev):
+                return
+            self.verify(ev)
+            self._db.set(_pending_key(ev), ev.bytes())
+        for cb in self.on_evidence:
+            cb(ev)
+
+    def report_conflicting_votes(self, vote_a: Vote, vote_b: Vote) -> None:
+        """Buffered until the next height's state is known (reference:
+        evidence/pool.go ReportConflictingVotes)."""
+        with self._mtx:
+            self._consensus_buffer.append((vote_a, vote_b))
+
+    def _process_consensus_buffer(self) -> None:
+        """reference: evidence/pool.go processConsensusBuffer."""
+        with self._mtx:
+            buffered, self._consensus_buffer = self._consensus_buffer, []
+        if not buffered:
+            return
+        state = self.state_store.load()
+        for vote_a, vote_b in buffered:
+            try:
+                if vote_a.height == state.last_block_height:
+                    val_set = state.last_validators
+                    block_meta = self.block_store.load_block_meta(vote_a.height)
+                    evidence_time = block_meta.header.time if block_meta else state.last_block_time
+                else:
+                    val_set = self.state_store.load_validators(vote_a.height)
+                    block_meta = self.block_store.load_block_meta(vote_a.height)
+                    evidence_time = block_meta.header.time if block_meta else Time.now()
+                ev = DuplicateVoteEvidence.new(vote_a, vote_b, evidence_time, val_set)
+                if ev is not None:
+                    with self._mtx:
+                        if not self.is_pending(ev) and not self.is_committed(ev):
+                            self._db.set(_pending_key(ev), ev.bytes())
+                    for cb in self.on_evidence:
+                        cb(ev)
+            except Exception:  # noqa: BLE001 - can't form evidence; drop
+                pass
+
+    # --- verification (reference: evidence/verify.go) ----------------------
+
+    def verify(self, ev) -> None:
+        state = self.state_store.load()
+        height = state.last_block_height
+        ev_params = state.consensus_params.evidence
+
+        # age check (reference: evidence/verify.go:19-60)
+        age_blocks = height - ev.height()
+        block_meta = self.block_store.load_block_meta(ev.height())
+        ev_time = block_meta.header.time if block_meta else ev.time()
+        age_ns = state.last_block_time.unix_ns() - ev_time.unix_ns()
+        if (age_blocks > ev_params.max_age_num_blocks
+                and age_ns > ev_params.max_age_duration_ns):
+            raise EvidenceError(
+                f"evidence from height {ev.height()} is too old; min height is "
+                f"{height - ev_params.max_age_num_blocks}"
+            )
+
+        if isinstance(ev, DuplicateVoteEvidence):
+            val_set = self.state_store.load_validators(ev.height())
+            self.verify_duplicate_vote(ev, state.chain_id, val_set)
+            # evidence metadata must match what we'd derive
+            _, val = val_set.get_by_address(ev.vote_a.validator_address)
+            if ev.validator_power != val.voting_power:
+                raise EvidenceError(
+                    f"evidence has validator power {ev.validator_power} but should be {val.voting_power}"
+                )
+            if ev.total_voting_power != val_set.total_voting_power():
+                raise EvidenceError(
+                    f"evidence has total power {ev.total_voting_power} but should be "
+                    f"{val_set.total_voting_power()}"
+                )
+        elif isinstance(ev, LightClientAttackEvidence):
+            self.verify_light_client_attack(ev, state)
+        else:
+            raise EvidenceError(f"unknown evidence type {type(ev)}")
+
+    @staticmethod
+    def verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str, val_set) -> None:
+        """reference: evidence/verify.go:162-220."""
+        _, val = val_set.get_by_address(ev.vote_a.validator_address)
+        if val is None:
+            raise EvidenceError(
+                f"address {ev.vote_a.validator_address.hex()} was not a validator at height {ev.height()}"
+            )
+        va, vb = ev.vote_a, ev.vote_b
+        if va.height != vb.height or va.round != vb.round or va.type != vb.type:
+            raise EvidenceError("H/R/S does not match")
+        if va.validator_address != vb.validator_address:
+            raise EvidenceError("validator addresses do not match")
+        if va.block_id == vb.block_id:
+            raise EvidenceError("block IDs are the same - not duplicate votes")
+        if va.block_id.key() >= vb.block_id.key():
+            raise EvidenceError("duplicate votes in invalid order")
+        pub = val.pub_key
+        if not pub.verify_signature(va.sign_bytes(chain_id), va.signature):
+            raise EvidenceError("invalid signature on vote A")
+        if not pub.verify_signature(vb.sign_bytes(chain_id), vb.signature):
+            raise EvidenceError("invalid signature on vote B")
+
+    def verify_light_client_attack(self, ev: LightClientAttackEvidence, state) -> None:
+        """reference: evidence/verify.go:113-160 (batched commit verify via
+        the ValidatorSet paths)."""
+        ev.validate_basic()
+        common_vals = self.state_store.load_validators(ev.common_height)
+        sh = ev.conflicting_block.signed_header
+        if sh is None or sh.commit is None:
+            raise EvidenceError("missing conflicting header/commit")
+        if ev.common_height != sh.header.height:
+            # skipping verification: 1/3 of common valset must have signed
+            common_vals.verify_commit_light_trusting(state.chain_id, sh.commit, (1, 3))
+        else:
+            vs = ev.conflicting_block.validator_set
+            if vs is None:
+                raise EvidenceError("missing conflicting validator set")
+            vs.verify_commit_light(state.chain_id, sh.commit.block_id,
+                                   sh.header.height, sh.commit)
+        # the conflicting header must differ from what we committed
+        ours = self.block_store.load_block_meta(sh.header.height)
+        if ours is not None and ours.block_id.hash == sh.header.hash():
+            raise EvidenceError("conflicting block is the same as our block; not an attack")
+
+    # --- lifecycle hooks ---------------------------------------------------
+
+    def check_evidence(self, state, evidence_list: list) -> None:
+        """Validate block evidence before accepting the block (reference:
+        evidence/pool.go CheckEvidence)."""
+        seen = set()
+        for ev in evidence_list:
+            h = ev.hash()
+            if h in seen:
+                raise EvidenceError("duplicate evidence in block")
+            seen.add(h)
+            if self.is_committed(ev):
+                raise EvidenceError("evidence was already committed")
+            if not self.is_pending(ev):
+                self.verify(ev)
+
+    def update(self, state, evidence_list: list) -> None:
+        """Mark committed + prune expired (reference: evidence/pool.go Update)."""
+        with self._mtx:
+            sets, deletes = [], []
+            for ev in evidence_list:
+                sets.append((_committed_key(ev), b"\x01"))
+                deletes.append(_pending_key(ev))
+            self._db.write_batch(sets, deletes)
+            # prune expired pending evidence
+            params = state.consensus_params.evidence
+            for k, v in list(self._db.iterator(b"p", b"q")):
+                ev = evidence_unmarshal(v)
+                age_blocks = state.last_block_height - ev.height()
+                age_ns = state.last_block_time.unix_ns() - ev.time().unix_ns()
+                if age_blocks > params.max_age_num_blocks and age_ns > params.max_age_duration_ns:
+                    self._db.delete(k)

@@ -1,0 +1,60 @@
+"""Evidence reactor: gossips evidence to peers (reference:
+evidence/reactor.go, channel 0x38, proto/tendermint/evidence/types.proto
+EvidenceList)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.evidence.pool import EvidencePool
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Peer, Reactor
+from tendermint_tpu.types.evidence import EvidenceError, evidence_unmarshal
+
+EVIDENCE_CHANNEL = 0x38
+BROADCAST_SLEEP_S = 0.5
+
+
+def msg_evidence_list(evs: list) -> bytes:
+    w = proto.Writer()
+    for ev in evs:
+        w.message(1, ev.bytes(), always=True)
+    return w.out()
+
+
+class EvidenceReactor(Reactor):
+    def __init__(self, pool: EvidencePool):
+        super().__init__("EVIDENCE")
+        self.pool = pool
+        self._peer_running: dict[str, bool] = {}
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(EVIDENCE_CHANNEL, priority=6)]
+
+    def add_peer(self, peer: Peer) -> None:
+        self._peer_running[peer.id] = True
+        threading.Thread(target=self._broadcast_routine, args=(peer,), daemon=True).start()
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        self._peer_running.pop(peer.id, None)
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        f = proto.fields(msg_bytes)
+        for raw in f.get(1, []):
+            try:
+                ev = evidence_unmarshal(raw)
+                self.pool.add_evidence(ev)
+            except EvidenceError:
+                pass
+
+    def _broadcast_routine(self, peer: Peer) -> None:
+        sent: set[bytes] = set()
+        while self._peer_running.get(peer.id) and self.switch is not None:
+            evs, _sz = self.pool.pending_evidence(-1)
+            fresh = [ev for ev in evs if ev.hash() not in sent]
+            if fresh:
+                if peer.try_send(EVIDENCE_CHANNEL, msg_evidence_list(fresh)):
+                    sent.update(ev.hash() for ev in fresh)
+            time.sleep(BROADCAST_SLEEP_S)

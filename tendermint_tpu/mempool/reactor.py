@@ -1,0 +1,80 @@
+"""Mempool reactor: tx gossip (reference: mempool/v0/reactor.go, channel 0x30,
+proto/tendermint/mempool/types.proto Message{Txs}).
+
+Each peer gets a gossip thread walking the mempool in insertion order (the
+reference's clist walk), skipping txs the peer already sent us."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.mempool.mempool import ErrTxInCache, Mempool, MempoolError
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Peer, Reactor
+
+MEMPOOL_CHANNEL = 0x30
+PEER_CATCHUP_SLEEP_S = 0.1
+
+
+def msg_txs(txs: list[bytes]) -> bytes:
+    inner = proto.Writer()
+    for t in txs:
+        inner.bytes(1, t)
+    return proto.Writer().message(1, inner.out(), always=True).out()
+
+
+class MempoolReactor(Reactor):
+    def __init__(self, mempool: Mempool, broadcast: bool = True):
+        super().__init__("MEMPOOL")
+        self.mempool = mempool
+        self.broadcast_txs = broadcast
+        self._peer_running: dict[str, bool] = {}
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5)]
+
+    def add_peer(self, peer: Peer) -> None:
+        if not self.broadcast_txs:
+            return
+        self._peer_running[peer.id] = True
+        threading.Thread(target=self._gossip_routine, args=(peer,), daemon=True).start()
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        self._peer_running.pop(peer.id, None)
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        f = proto.fields(msg_bytes)
+        if 1 not in f:
+            return
+        inner = proto.fields(f[1][-1])
+        for tx in inner.get(1, []):
+            try:
+                self.mempool.check_tx(tx, sender_peer=peer.id)
+            except ErrTxInCache:
+                pass
+            except MempoolError:
+                pass
+
+    def _gossip_routine(self, peer: Peer) -> None:
+        """One-tx-at-a-time walk (reference: mempool/v0/reactor.go
+        broadcastTxRoutine)."""
+        sent_seq = 0
+        while self._peer_running.get(peer.id) and self.switch is not None:
+            entries = self.mempool.iter_txs()
+            progressed = False
+            for m in entries:
+                if m.seq <= sent_seq:
+                    continue
+                if peer.id in m.senders:
+                    sent_seq = m.seq
+                    progressed = True
+                    continue
+                # don't send txs for future heights the peer can't process yet
+                if peer.try_send(MEMPOOL_CHANNEL, msg_txs([m.tx])):
+                    sent_seq = m.seq
+                    progressed = True
+                break
+            if not progressed:
+                time.sleep(PEER_CATCHUP_SLEEP_S)

@@ -1,0 +1,197 @@
+"""Node: the DI root wiring stores, ABCI app, mempool, consensus, and p2p
+(reference: node/node.go:100,706,941).
+"""
+
+from __future__ import annotations
+
+import os
+
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.config.config import Config
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.consensus.state_machine import ConsensusState
+from tendermint_tpu.consensus.wal import WAL
+from tendermint_tpu.mempool.mempool import Mempool
+from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.switch import Switch, Transport
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import make_genesis_state
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.store.db import new_db
+from tendermint_tpu.types.events import EventBus
+from tendermint_tpu.types.genesis import GenesisDoc
+
+
+def default_app(name: str):
+    """In-proc app selection (reference: proxy/client.go:75
+    DefaultClientCreator)."""
+    if name in ("kvstore", "persistent_kvstore"):
+        return KVStoreApplication()
+    if name == "noop":
+        from tendermint_tpu.abci.types import Application
+
+        return Application()
+    raise ValueError(f"unknown in-proc app {name!r}; socket/grpc apps not wired here")
+
+
+class Node:
+    """reference: node/node.go:706 NewNode."""
+
+    def __init__(self, config: Config, app=None, genesis: GenesisDoc | None = None,
+                 priv_validator=None, node_key: NodeKey | None = None,
+                 logger=None):
+        self.config = config
+        self.logger = logger
+
+        # DBs (reference: node/node.go:716,235 initDBs)
+        backend = config.base.db_backend
+        dbdir = config.db_dir()
+        self.block_store = BlockStore(new_db(backend, os.path.join(dbdir, "blockstore.db")
+                                             if backend != "memdb" else None))
+        self.state_store = StateStore(new_db(backend, os.path.join(dbdir, "state.db")
+                                             if backend != "memdb" else None))
+
+        # genesis + state
+        self.genesis = genesis if genesis is not None else GenesisDoc.from_file(config.genesis_file())
+        state = self.state_store.load()
+        if state.is_empty():
+            state = make_genesis_state(self.genesis)
+            self.state_store.save(state)
+
+        # app (in-proc by default; socket ABCI via abci.server elsewhere)
+        self.app = app if app is not None else default_app(config.base.proxy_app)
+
+        # ABCI handshake/replay (reference: node/node.go:777 doHandshake)
+        from tendermint_tpu.consensus.replay import Handshaker
+
+        self.event_bus = EventBus()
+        handshaker = Handshaker(self.state_store, self.block_store, self.genesis)
+        state = handshaker.handshake(state, self.app)
+
+        # priv validator
+        if priv_validator is None and config.base.priv_validator_key_file:
+            priv_validator = FilePV.load_or_generate(
+                config.priv_validator_key_file(), config.priv_validator_state_file()
+            )
+        self.priv_validator = priv_validator
+
+        # mempool
+        self.mempool = Mempool(
+            self.app,
+            version=config.mempool.version,
+            max_txs=config.mempool.size,
+            max_txs_bytes=config.mempool.max_txs_bytes,
+            cache_size=config.mempool.cache_size,
+            max_tx_bytes=config.mempool.max_tx_bytes,
+            keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
+            recheck=config.mempool.recheck,
+        )
+
+        # evidence pool
+        from tendermint_tpu.evidence.pool import EvidencePool
+
+        self.evidence_pool = EvidencePool(new_db("memdb"), self.state_store, self.block_store)
+
+        # block executor
+        self.block_exec = BlockExecutor(
+            self.state_store, self.app, mempool=self.mempool,
+            evidence_pool=self.evidence_pool, event_bus=self.event_bus,
+            block_store=self.block_store,
+        )
+
+        # consensus
+        wal = WAL(config.wal_file()) if config.consensus.wal_path else None
+        self.consensus = ConsensusState(
+            config.consensus, state, self.block_exec, self.block_store,
+            mempool=self.mempool, evidence_pool=self.evidence_pool,
+            priv_validator=self.priv_validator, event_bus=self.event_bus, wal=wal,
+        )
+        if config.mempool.broadcast:
+            self.mempool.enable_txs_available()
+
+        # p2p
+        self.node_key = node_key if node_key is not None else NodeKey.load_or_gen(
+            config.node_key_file())
+        node_info = NodeInfo(
+            node_id=self.node_key.id(),
+            network=self.genesis.chain_id,
+            moniker=config.base.moniker,
+        )
+        self.transport = Transport(self.node_key, node_info,
+                                   config.p2p.handshake_timeout_s,
+                                   config.p2p.dial_timeout_s)
+        self.switch = Switch(self.transport, logger=logger,
+                             max_inbound=config.p2p.max_num_inbound_peers,
+                             max_outbound=config.p2p.max_num_outbound_peers)
+
+        fast_sync = config.base.fast_sync_mode and len(self.genesis.validators) > 1
+        self.consensus_reactor = ConsensusReactor(self.consensus, wait_sync=fast_sync)
+        self.mempool_reactor = MempoolReactor(self.mempool, broadcast=config.mempool.broadcast)
+
+        from tendermint_tpu.blockchain.reactor import BlockchainReactor
+        from tendermint_tpu.evidence.reactor import EvidenceReactor
+
+        self.bc_reactor = BlockchainReactor(
+            state, self.block_exec, self.block_store, fast_sync,
+            self.consensus_reactor)
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool)
+
+        self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
+        self.switch.add_reactor("BLOCKCHAIN", self.bc_reactor)
+        self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
+        self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
+
+        self.rpc_server = None
+        self._tx_notify_thread = None
+
+    # --- lifecycle (reference: node/node.go:941 OnStart) -------------------
+
+    def start(self) -> None:
+        if self.config.p2p.laddr:
+            self.transport.listen(self.config.p2p.laddr)
+        self.switch.start()
+        if self.config.p2p.persistent_peers:
+            self.switch.add_persistent_peers(
+                self.config.p2p.persistent_peers.split(","))
+        if not self.consensus_reactor.wait_sync:
+            self.consensus.start()
+        else:
+            self.bc_reactor.start_sync()
+        if self.mempool.txs_available() is not None:
+            import threading
+
+            def notify():
+                ev = self.mempool.txs_available()
+                while self._running:
+                    if ev.wait(timeout=0.2):
+                        ev.clear()
+                        self.consensus.handle_txs_available()
+
+            self._running = True
+            self._tx_notify_thread = threading.Thread(target=notify, daemon=True)
+            self._tx_notify_thread.start()
+        else:
+            self._running = True
+        # RPC
+        if self.config.rpc.laddr:
+            from tendermint_tpu.rpc.server import RPCServer
+
+            self.rpc_server = RPCServer(self)
+            self.rpc_server.start(self.config.rpc.laddr)
+
+    def stop(self) -> None:
+        self._running = False
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
+        self.consensus.stop()
+        self.switch.stop()
+
+    # --- helpers -----------------------------------------------------------
+
+    def p2p_addr(self) -> str:
+        la = self.transport.node_info.listen_addr
+        return f"{self.node_key.id()}@{la.split('://', 1)[1]}" if la else ""

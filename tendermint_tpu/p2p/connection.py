@@ -1,0 +1,223 @@
+"""MConnection: multiplexes priority channels over one SecretConnection
+(reference: p2p/conn/connection.go:78, proto/tendermint/p2p/conn.proto).
+
+Wire format: varint-delimited Packet protos over the encrypted stream.
+  Packet { oneof sum: PacketPing = 1 | PacketPong = 2 | PacketMsg = 3 }
+  PacketMsg { channel_id = 1; eof = 2; data = 3 }
+Messages larger than the packet payload size are split across PacketMsgs and
+reassembled at eof. Channel scheduling is priority-weighted ratio picking
+like the reference's sendRoutine (connection.go:320-420).
+"""
+
+from __future__ import annotations
+
+import queue
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tendermint_tpu.encoding import proto
+
+MAX_PACKET_MSG_PAYLOAD_SIZE = 1024
+PING_INTERVAL_S = 20.0
+PONG_TIMEOUT_S = 45.0
+FLUSH_THROTTLE_S = 0.01
+MAX_MSG_SIZE = 10 * 1024 * 1024
+
+
+class MConnectionError(Exception):
+    pass
+
+
+@dataclass
+class ChannelDescriptor:
+    """reference: p2p/conn/connection.go:560-600."""
+
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = 100
+    recv_message_capacity: int = 22020096
+
+
+class _Channel:
+    def __init__(self, desc: ChannelDescriptor):
+        self.desc = desc
+        self.send_queue: queue.Queue = queue.Queue(maxsize=desc.send_queue_capacity)
+        self.sending: bytes | None = None
+        self.sent_pos = 0
+        self.recently_sent = 0
+        self.recving = bytearray()
+
+    def is_send_pending(self) -> bool:
+        return self.sending is not None or not self.send_queue.empty()
+
+    def next_packet(self) -> tuple[bytes, bool]:
+        if self.sending is None:
+            self.sending = self.send_queue.get_nowait()
+            self.sent_pos = 0
+        chunk = self.sending[self.sent_pos : self.sent_pos + MAX_PACKET_MSG_PAYLOAD_SIZE]
+        self.sent_pos += len(chunk)
+        eof = self.sent_pos >= len(self.sending)
+        if eof:
+            self.sending = None
+            self.sent_pos = 0
+        self.recently_sent += len(chunk)
+        return chunk, eof
+
+
+class MConnection:
+    """on_receive(ch_id, msg_bytes); on_error(err) when the conn dies."""
+
+    def __init__(self, conn, channels: list[ChannelDescriptor], on_receive,
+                 on_error=None):
+        self._conn = conn
+        self._channels = {d.id: _Channel(d) for d in channels}
+        self._on_receive = on_receive
+        self._on_error = on_error
+        self._send_event = threading.Event()
+        self._running = False
+        self._send_thread: threading.Thread | None = None
+        self._recv_thread: threading.Thread | None = None
+        self._last_recv = time.monotonic()
+        self._recv_stream = b""
+
+    def start(self) -> None:
+        self._running = True
+        self._send_thread = threading.Thread(target=self._send_routine, daemon=True)
+        self._recv_thread = threading.Thread(target=self._recv_routine, daemon=True)
+        self._send_thread.start()
+        self._recv_thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self._send_event.set()
+        self._conn.close()
+
+    # --- sending -----------------------------------------------------------
+
+    def send(self, ch_id: int, msg: bytes, block: bool = True) -> bool:
+        """Queue a message on a channel (reference: connection.go:250-290)."""
+        ch = self._channels.get(ch_id)
+        if ch is None or not self._running:
+            return False
+        try:
+            ch.send_queue.put(msg, block=block, timeout=10 if block else None)
+        except queue.Full:
+            return False
+        self._send_event.set()
+        return True
+
+    def try_send(self, ch_id: int, msg: bytes) -> bool:
+        return self.send(ch_id, msg, block=False)
+
+    def _pick_channel(self) -> _Channel | None:
+        """Least ratio of recentlySent/priority (reference:
+        connection.go:380-420 sendPacketMsg)."""
+        best, least = None, None
+        for ch in self._channels.values():
+            if not ch.is_send_pending():
+                continue
+            ratio = ch.recently_sent / ch.desc.priority
+            if least is None or ratio < least:
+                least = ratio
+                best = ch
+        return best
+
+    def _send_routine(self) -> None:
+        last_ping = time.monotonic()
+        try:
+            while self._running:
+                ch = self._pick_channel()
+                if ch is None:
+                    if time.monotonic() - last_ping > PING_INTERVAL_S:
+                        self._write_packet(proto.Writer().message(1, b"", always=True).out())
+                        last_ping = time.monotonic()
+                    fired = self._send_event.wait(timeout=0.05)
+                    if fired:
+                        self._send_event.clear()
+                    # decay recentlySent (flowrate stand-in)
+                    for c in self._channels.values():
+                        c.recently_sent = int(c.recently_sent * 0.8)
+                    continue
+                chunk, eof = ch.next_packet()
+                pm = (
+                    proto.Writer()
+                    .varint(1, ch.desc.id)
+                    .bool(2, eof)
+                    .bytes(3, chunk)
+                    .out()
+                )
+                self._write_packet(proto.Writer().message(3, pm, always=True).out())
+        except Exception as e:  # noqa: BLE001
+            self._die(e)
+
+    def _write_packet(self, packet: bytes) -> None:
+        self._conn.write(proto.delimited(packet))
+
+    # --- receiving ---------------------------------------------------------
+
+    def _read_delimited(self) -> bytes:
+        # varint length then body, over the stream-oriented secret conn
+        ln = 0
+        shift = 0
+        while True:
+            b = self._read_bytes(1)[0]
+            ln |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+            if shift > 35:
+                raise MConnectionError("bad packet length varint")
+        if ln > MAX_MSG_SIZE:
+            raise MConnectionError(f"packet too big: {ln}")
+        return self._read_bytes(ln)
+
+    def _read_bytes(self, n: int) -> bytes:
+        while len(self._recv_stream) < n:
+            chunk = self._conn.read(65536)
+            if not chunk:
+                raise MConnectionError("connection closed")
+            self._recv_stream += chunk
+        out = self._recv_stream[:n]
+        self._recv_stream = self._recv_stream[n:]
+        return out
+
+    def _recv_routine(self) -> None:
+        try:
+            while self._running:
+                packet = self._read_delimited()
+                f = proto.fields(packet)
+                if 1 in f:  # ping -> pong
+                    self._write_packet(proto.Writer().message(2, b"", always=True).out())
+                elif 2 in f:  # pong
+                    self._last_recv = time.monotonic()
+                elif 3 in f:
+                    pf = proto.fields(f[3][-1])
+                    ch_id = proto.as_sint64(pf.get(1, [0])[-1])
+                    eof = bool(pf.get(2, [0])[-1])
+                    data = pf.get(3, [b""])[-1]
+                    ch = self._channels.get(ch_id)
+                    if ch is None:
+                        raise MConnectionError(f"unknown channel {ch_id:#x}")
+                    ch.recving += data
+                    if len(ch.recving) > ch.desc.recv_message_capacity:
+                        raise MConnectionError("received message exceeds capacity")
+                    if eof:
+                        msg = bytes(ch.recving)
+                        ch.recving = bytearray()
+                        self._on_receive(ch_id, msg)
+                self._last_recv = time.monotonic()
+        except Exception as e:  # noqa: BLE001
+            self._die(e)
+
+    def _die(self, err: Exception) -> None:
+        if not self._running:
+            return
+        self._running = False
+        try:
+            self._conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        if self._on_error is not None:
+            self._on_error(err)

@@ -1,0 +1,56 @@
+"""Node identity key (reference: p2p/key.go).
+
+Node ID = hex(address of ed25519 node pubkey) (20 bytes -> 40 hex chars).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+
+from tendermint_tpu.crypto import ed25519
+
+ID_BYTE_LENGTH = 20
+
+
+class NodeKey:
+    def __init__(self, priv_key: ed25519.PrivKey):
+        self.priv_key = priv_key
+
+    def id(self) -> str:
+        return self.priv_key.pub_key().address().hex()
+
+    def pub_key(self) -> ed25519.PubKey:
+        return self.priv_key.pub_key()
+
+    def save_as(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        doc = {
+            "priv_key": {
+                "type": "tendermint/PrivKeyEd25519",
+                "value": base64.b64encode(self.priv_key.bytes()).decode(),
+            }
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+
+    @staticmethod
+    def load(path: str) -> "NodeKey":
+        with open(path) as f:
+            doc = json.load(f)
+        return NodeKey(ed25519.PrivKey(base64.b64decode(doc["priv_key"]["value"])))
+
+    @staticmethod
+    def load_or_gen(path: str) -> "NodeKey":
+        if os.path.exists(path):
+            return NodeKey.load(path)
+        nk = NodeKey(ed25519.gen_priv_key())
+        nk.save_as(path)
+        return nk
+
+
+def validate_id(node_id: str) -> None:
+    if len(node_id) != 2 * ID_BYTE_LENGTH:
+        raise ValueError(f"invalid node ID length {len(node_id)}")
+    bytes.fromhex(node_id)

@@ -1,0 +1,93 @@
+"""NodeInfo: identity/version handshake payload (reference: p2p/node_info.go,
+proto/tendermint/p2p/types.proto DefaultNodeInfo)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.encoding import proto
+
+P2P_PROTOCOL = 8     # reference: version/version.go:18
+BLOCK_PROTOCOL = 11  # reference: version/version.go:21
+MAX_NUM_CHANNELS = 64
+
+
+@dataclass
+class NodeInfo:
+    p2p_version: int = P2P_PROTOCOL
+    block_version: int = BLOCK_PROTOCOL
+    app_version: int = 0
+    node_id: str = ""
+    listen_addr: str = ""
+    network: str = ""
+    version: str = "0.34.24-tpu"
+    channels: bytes = b""
+    moniker: str = ""
+    tx_index: str = "on"
+    rpc_address: str = ""
+
+    def validate_basic(self) -> None:
+        if len(self.node_id) != 40:
+            raise ValueError("invalid node ID")
+        if len(self.channels) > MAX_NUM_CHANNELS:
+            raise ValueError("too many channels")
+        if len(set(self.channels)) != len(self.channels):
+            raise ValueError("duplicate channel id")
+
+    def compatible_with(self, other: "NodeInfo") -> None:
+        """reference: p2p/node_info.go CompatibleWith."""
+        if self.block_version != other.block_version:
+            raise ValueError(
+                f"peer is on a different Block version. Got {other.block_version}, "
+                f"expected {self.block_version}"
+            )
+        if self.network != other.network:
+            raise ValueError(
+                f"peer is on a different network. Got {other.network!r}, "
+                f"expected {self.network!r}"
+            )
+        if not self.channels:
+            return
+        if not any(ch in self.channels for ch in other.channels):
+            raise ValueError("peer has no common channels")
+
+    def marshal(self) -> bytes:
+        pv = (
+            proto.Writer()
+            .uvarint(1, self.p2p_version)
+            .uvarint(2, self.block_version)
+            .uvarint(3, self.app_version)
+            .out()
+        )
+        other = proto.Writer().string(1, self.tx_index).string(2, self.rpc_address).out()
+        return (
+            proto.Writer()
+            .message(1, pv, always=True)
+            .string(2, self.node_id)
+            .string(3, self.listen_addr)
+            .string(4, self.network)
+            .string(5, self.version)
+            .bytes(6, self.channels)
+            .string(7, self.moniker)
+            .message(8, other, always=True)
+            .out()
+        )
+
+    @staticmethod
+    def unmarshal(buf: bytes) -> "NodeInfo":
+        f = proto.fields(buf)
+        pv = proto.fields(f.get(1, [b""])[-1])
+        other = proto.fields(f.get(8, [b""])[-1])
+        return NodeInfo(
+            p2p_version=pv.get(1, [0])[-1],
+            block_version=pv.get(2, [0])[-1],
+            app_version=pv.get(3, [0])[-1],
+            node_id=f.get(2, [b""])[-1].decode(),
+            listen_addr=f.get(3, [b""])[-1].decode(),
+            network=f.get(4, [b""])[-1].decode(),
+            version=f.get(5, [b""])[-1].decode(),
+            channels=f.get(6, [b""])[-1],
+            moniker=f.get(7, [b""])[-1].decode(),
+            tx_index=other.get(1, [b"on"])[-1].decode() if 1 in other else "on",
+            rpc_address=other.get(2, [b""])[-1].decode() if 2 in other else "",
+        )

@@ -1,0 +1,325 @@
+"""Peer, Transport, Switch, Reactor: the p2p service layer (reference:
+p2p/switch.go, p2p/transport.go, p2p/peer.go, p2p/base_reactor.go:15-54).
+
+Transport: TCP listen/dial -> SecretConnection -> NodeInfo handshake.
+Peer: one MConnection; reactors receive (ch_id, peer, msg_bytes).
+Switch: reactor registry, peer lifecycle, broadcast, dial/accept loops,
+reconnect-to-persistent-peers.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.p2p.connection import ChannelDescriptor, MConnection
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.secret_connection import SecretConnection
+
+
+class P2PError(Exception):
+    pass
+
+
+class Reactor:
+    """reference: p2p/base_reactor.go:15-54."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.switch: "Switch | None" = None
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return []
+
+    def add_peer(self, peer: "Peer") -> None:
+        pass
+
+    def remove_peer(self, peer: "Peer", reason) -> None:
+        pass
+
+    def receive(self, ch_id: int, peer: "Peer", msg_bytes: bytes) -> None:
+        pass
+
+    def on_start(self) -> None:
+        pass
+
+    def on_stop(self) -> None:
+        pass
+
+
+class Peer:
+    """reference: p2p/peer.go:23."""
+
+    def __init__(self, conn: SecretConnection, node_info: NodeInfo,
+                 channels: list[ChannelDescriptor], on_receive, on_error,
+                 outbound: bool, persistent: bool = False,
+                 socket_addr: str = ""):
+        self.node_info = node_info
+        self.outbound = outbound
+        self.persistent = persistent
+        self.socket_addr = socket_addr
+        self._data: dict = {}
+        self.mconn = MConnection(
+            conn, channels,
+            on_receive=lambda ch, msg: on_receive(ch, self, msg),
+            on_error=lambda err: on_error(self, err),
+        )
+
+    @property
+    def id(self) -> str:
+        return self.node_info.node_id
+
+    def start(self) -> None:
+        self.mconn.start()
+
+    def stop(self) -> None:
+        self.mconn.stop()
+
+    def send(self, ch_id: int, msg: bytes) -> bool:
+        return self.mconn.send(ch_id, msg)
+
+    def try_send(self, ch_id: int, msg: bytes) -> bool:
+        return self.mconn.try_send(ch_id, msg)
+
+    def set(self, key: str, value) -> None:
+        self._data[key] = value
+
+    def get(self, key: str):
+        return self._data.get(key)
+
+    def __repr__(self) -> str:
+        return f"Peer{{{self.id[:12]} {'out' if self.outbound else 'in'}}}"
+
+
+class Transport:
+    """MultiplexTransport equivalent (reference: p2p/transport.go)."""
+
+    def __init__(self, node_key: NodeKey, node_info: NodeInfo,
+                 handshake_timeout_s: float = 20.0, dial_timeout_s: float = 3.0):
+        self.node_key = node_key
+        self.node_info = node_info
+        self.handshake_timeout_s = handshake_timeout_s
+        self.dial_timeout_s = dial_timeout_s
+        self._listener: socket.socket | None = None
+
+    def listen(self, addr: str) -> str:
+        host, port = _split_addr(addr)
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, port))
+        s.listen(64)
+        self._listener = s
+        actual = s.getsockname()
+        self.node_info.listen_addr = f"tcp://{actual[0]}:{actual[1]}"
+        return self.node_info.listen_addr
+
+    def accept(self) -> tuple[SecretConnection, NodeInfo, str]:
+        if self._listener is None:
+            raise P2PError("transport not listening")
+        raw, addr = self._listener.accept()
+        return self._upgrade(raw, f"{addr[0]}:{addr[1]}")
+
+    def dial(self, addr: str) -> tuple[SecretConnection, NodeInfo, str]:
+        host, port = _split_addr(addr)
+        raw = socket.create_connection((host, port), timeout=self.dial_timeout_s)
+        return self._upgrade(raw, f"{host}:{port}")
+
+    def _upgrade(self, raw: socket.socket, addr: str):
+        raw.settimeout(self.handshake_timeout_s)
+        conn = SecretConnection(raw, self.node_key.priv_key)
+        # NodeInfo exchange (reference: transport.go handshake)
+        conn.write(proto.delimited(self.node_info.marshal()))
+        buf = conn.read_msg()
+        while True:
+            try:
+                body, _ = proto.parse_delimited(buf)
+                break
+            except ValueError:
+                buf += conn.read_msg()
+        peer_info = NodeInfo.unmarshal(body)
+        peer_info.validate_basic()
+        # The authenticated ed25519 key must match the claimed node ID.
+        derived = conn.remote_pub_key.address().hex()
+        if derived != peer_info.node_id:
+            raise P2PError(
+                f"peer ID mismatch: claimed {peer_info.node_id}, authenticated {derived}"
+            )
+        raw.settimeout(None)
+        return conn, peer_info, addr
+
+    def close(self) -> None:
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+
+class Switch:
+    """reference: p2p/switch.go:65."""
+
+    def __init__(self, transport: Transport, logger=None,
+                 max_inbound: int = 40, max_outbound: int = 10):
+        self.transport = transport
+        self.reactors: dict[str, Reactor] = {}
+        self._channels: list[ChannelDescriptor] = []
+        self._reactors_by_ch: dict[int, Reactor] = {}
+        self.peers: dict[str, Peer] = {}
+        self._peers_mtx = threading.RLock()
+        self._running = False
+        self.logger = logger
+        self.max_inbound = max_inbound
+        self.max_outbound = max_outbound
+        self._persistent_addrs: list[str] = []
+        self._accept_thread: threading.Thread | None = None
+        self._reconnect_thread: threading.Thread | None = None
+
+    # --- registry ----------------------------------------------------------
+
+    def add_reactor(self, name: str, reactor: Reactor) -> Reactor:
+        for d in reactor.get_channels():
+            if d.id in self._reactors_by_ch:
+                raise P2PError(f"channel {d.id:#x} already registered")
+            self._channels.append(d)
+            self._reactors_by_ch[d.id] = reactor
+        self.reactors[name] = reactor
+        reactor.switch = self
+        self.transport.node_info.channels = bytes(sorted(self._reactors_by_ch))
+        return reactor
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._running = True
+        for r in self.reactors.values():
+            r.on_start()
+        if self.transport._listener is not None:
+            self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+            self._accept_thread.start()
+        self._reconnect_thread = threading.Thread(target=self._reconnect_loop, daemon=True)
+        self._reconnect_thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        for r in self.reactors.values():
+            r.on_stop()
+        with self._peers_mtx:
+            peers = list(self.peers.values())
+        for p in peers:
+            self.stop_peer_for_error(p, "switch stopping")
+        self.transport.close()
+
+    # --- dialing / accepting -----------------------------------------------
+
+    def dial_peer(self, addr: str, persistent: bool = False) -> Peer | None:
+        try:
+            conn, peer_info, sock_addr = self.transport.dial(addr)
+            return self._add_peer(conn, peer_info, outbound=True,
+                                  persistent=persistent, socket_addr=addr)
+        except Exception as e:  # noqa: BLE001
+            if self.logger:
+                self.logger.info("dial failed", addr=addr, err=e)
+            return None
+
+    def add_persistent_peers(self, addrs: list[str]) -> None:
+        self._persistent_addrs.extend(a for a in addrs if a)
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, peer_info, sock_addr = self.transport.accept()
+            except Exception:  # noqa: BLE001
+                if not self._running:
+                    return
+                continue
+            n_in = sum(1 for p in self.peers.values() if not p.outbound)
+            if n_in >= self.max_inbound:
+                conn.close()
+                continue
+            try:
+                self._add_peer(conn, peer_info, outbound=False, socket_addr=sock_addr)
+            except Exception:  # noqa: BLE001
+                conn.close()
+
+    def _reconnect_loop(self) -> None:
+        while self._running:
+            for addr in list(self._persistent_addrs):
+                node_id = addr.split("@")[0] if "@" in addr else None
+                have = node_id in self.peers if node_id else any(
+                    p.socket_addr.endswith(addr) for p in self.peers.values()
+                )
+                if not have:
+                    self.dial_peer(addr, persistent=True)
+            time.sleep(1.0)
+
+    def _add_peer(self, conn, peer_info: NodeInfo, outbound: bool,
+                  persistent: bool = False, socket_addr: str = "") -> Peer:
+        self.transport.node_info.compatible_with(peer_info)
+        if peer_info.node_id == self.transport.node_info.node_id:
+            conn.close()
+            raise P2PError("connected to self")
+        with self._peers_mtx:
+            if peer_info.node_id in self.peers:
+                conn.close()
+                raise P2PError("duplicate peer")
+            peer = Peer(conn, peer_info, self._channels, self._on_receive,
+                        self._on_peer_error, outbound, persistent, socket_addr)
+            self.peers[peer.id] = peer
+        peer.start()
+        for r in self.reactors.values():
+            r.add_peer(peer)
+        return peer
+
+    # --- peer events -------------------------------------------------------
+
+    def _on_receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        reactor = self._reactors_by_ch.get(ch_id)
+        if reactor is None:
+            self.stop_peer_for_error(peer, f"unknown channel {ch_id:#x}")
+            return
+        try:
+            reactor.receive(ch_id, peer, msg_bytes)
+        except Exception as e:  # noqa: BLE001
+            self.stop_peer_for_error(peer, e)
+
+    def _on_peer_error(self, peer: Peer, err) -> None:
+        self.stop_peer_for_error(peer, err)
+
+    def stop_peer_for_error(self, peer: Peer, reason) -> None:
+        """reference: p2p/switch.go StopPeerForError."""
+        with self._peers_mtx:
+            if self.peers.get(peer.id) is not peer:
+                return
+            del self.peers[peer.id]
+        peer.stop()
+        for r in self.reactors.values():
+            try:
+                r.remove_peer(peer, reason)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # --- broadcast ---------------------------------------------------------
+
+    def broadcast(self, ch_id: int, msg: bytes) -> None:
+        with self._peers_mtx:
+            peers = list(self.peers.values())
+        for p in peers:
+            p.try_send(ch_id, msg)
+
+    def num_peers(self) -> tuple[int, int]:
+        with self._peers_mtx:
+            out = sum(1 for p in self.peers.values() if p.outbound)
+            return out, len(self.peers) - out
+
+
+def _split_addr(addr: str) -> tuple[str, int]:
+    a = addr
+    if "://" in a:
+        a = a.split("://", 1)[1]
+    if "@" in a:
+        a = a.split("@", 1)[1]
+    host, port = a.rsplit(":", 1)
+    return host, int(port)

@@ -1,0 +1,477 @@
+"""RPC route handlers (reference: rpc/core/*.go, routes at
+rpc/core/routes.go:12-48). JSON result shapes follow the reference's
+response types (amino-style JSON: hex upper-case hashes, stringified ints).
+"""
+
+from __future__ import annotations
+
+import base64
+import time as _time
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.types import events as tmevents
+from tendermint_tpu.types.tx import tx_hash
+
+
+class Environment:
+    """reference: rpc/core/env.go Environment."""
+
+    def __init__(self, node):
+        self.node = node
+        self.event_bus = node.event_bus
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b or b"").decode()
+
+
+def _hex(b: bytes) -> str:
+    return (b or b"").hex().upper()
+
+
+def _block_id_json(bid) -> dict:
+    return {
+        "hash": _hex(bid.hash),
+        "parts": {"total": bid.part_set_header.total, "hash": _hex(bid.part_set_header.hash)},
+    }
+
+
+def _header_json(h) -> dict:
+    return {
+        "version": {"block": str(h.version.block), "app": str(h.version.app)},
+        "chain_id": h.chain_id,
+        "height": str(h.height),
+        "time": str(h.time),
+        "last_block_id": _block_id_json(h.last_block_id),
+        "last_commit_hash": _hex(h.last_commit_hash),
+        "data_hash": _hex(h.data_hash),
+        "validators_hash": _hex(h.validators_hash),
+        "next_validators_hash": _hex(h.next_validators_hash),
+        "consensus_hash": _hex(h.consensus_hash),
+        "app_hash": _hex(h.app_hash),
+        "last_results_hash": _hex(h.last_results_hash),
+        "evidence_hash": _hex(h.evidence_hash),
+        "proposer_address": _hex(h.proposer_address),
+    }
+
+
+def _commit_json(c) -> dict:
+    if c is None:
+        return None
+    return {
+        "height": str(c.height),
+        "round": c.round,
+        "block_id": _block_id_json(c.block_id),
+        "signatures": [
+            {
+                "block_id_flag": s.block_id_flag,
+                "validator_address": _hex(s.validator_address),
+                "timestamp": str(s.timestamp),
+                "signature": _b64(s.signature),
+            }
+            for s in c.signatures
+        ],
+    }
+
+
+def _block_json(b) -> dict:
+    return {
+        "header": _header_json(b.header),
+        "data": {"txs": [_b64(t) for t in b.data.txs]},
+        "evidence": {"evidence": []},
+        "last_commit": _commit_json(b.last_commit),
+    }
+
+
+def encode_event_data(data) -> dict:
+    """Event payloads for WS subscriptions."""
+    if isinstance(data, tmevents.EventDataNewBlock):
+        return {"type": "tendermint/event/NewBlock",
+                "value": {"block": _block_json(data.block)}}
+    if isinstance(data, tmevents.EventDataTx):
+        return {"type": "tendermint/event/Tx", "value": {
+            "TxResult": {"height": str(data.height), "index": data.index,
+                         "tx": _b64(data.tx),
+                         "result": {"code": data.result.code if data.result else 0}}}}
+    if isinstance(data, tmevents.EventDataNewBlockHeader):
+        return {"type": "tendermint/event/NewBlockHeader",
+                "value": {"header": _header_json(data.header)}}
+    if isinstance(data, tmevents.EventDataRoundState):
+        return {"type": "tendermint/event/RoundState", "value": {
+            "height": str(data.height), "round": data.round, "step": data.step}}
+    if isinstance(data, tmevents.EventDataVote):
+        return {"type": "tendermint/event/Vote", "value": {"vote": str(data.vote)}}
+    return {"type": type(data).__name__, "value": {}}
+
+
+# --- info routes (reference: rpc/core/routes.go) ----------------------------
+
+
+def health(env):
+    return {}
+
+
+def status(env):
+    node = env.node
+    latest_height = node.block_store.height
+    meta = node.block_store.load_block_meta(latest_height)
+    earliest_meta = node.block_store.load_base_meta()
+    pub = node.priv_validator.get_pub_key() if node.priv_validator else None
+    return {
+        "node_info": {
+            "protocol_version": {"p2p": "8", "block": "11", "app": "0"},
+            "id": node.node_key.id(),
+            "listen_addr": node.transport.node_info.listen_addr,
+            "network": node.genesis.chain_id,
+            "version": "0.34.24-tpu",
+            "moniker": node.config.base.moniker,
+        },
+        "sync_info": {
+            "latest_block_hash": _hex(meta.block_id.hash) if meta else "",
+            "latest_app_hash": _hex(meta.header.app_hash) if meta else "",
+            "latest_block_height": str(latest_height),
+            "latest_block_time": str(meta.header.time) if meta else "",
+            "earliest_block_height": str(node.block_store.base),
+            "earliest_block_time": str(earliest_meta.header.time) if earliest_meta else "",
+            "catching_up": bool(getattr(node.consensus_reactor, "wait_sync", False)),
+        },
+        "validator_info": {
+            "address": _hex(pub.address()) if pub else "",
+            "pub_key": {"type": "tendermint/PubKeyEd25519", "value": _b64(pub.bytes())} if pub else None,
+            "voting_power": "0",
+        },
+    }
+
+
+def net_info(env):
+    sw = env.node.switch
+    with sw._peers_mtx:
+        peers = list(sw.peers.values())
+    return {
+        "listening": True,
+        "listeners": [env.node.transport.node_info.listen_addr],
+        "n_peers": str(len(peers)),
+        "peers": [
+            {"node_info": {"id": p.id, "moniker": p.node_info.moniker},
+             "is_outbound": p.outbound, "remote_ip": p.socket_addr}
+            for p in peers
+        ],
+    }
+
+
+def genesis(env):
+    import json as _json
+
+    return {"genesis": _json.loads(env.node.genesis.to_json())}
+
+
+def genesis_chunked(env, chunk=0):
+    data = env.node.genesis.to_json().encode()
+    chunk_size = 16 * 1024 * 1024
+    chunks = [data[i:i + chunk_size] for i in range(0, len(data), chunk_size)] or [b""]
+    c = int(chunk)
+    if c < 0 or c >= len(chunks):
+        raise ValueError(f"there are {len(chunks)} chunks, but you requested {c}")
+    return {"chunk": str(c), "total": str(len(chunks)), "data": _b64(chunks[c])}
+
+
+def blockchain(env, minHeight=0, maxHeight=0):
+    """reference: rpc/core/blocks.go BlockchainInfo."""
+    store = env.node.block_store
+    max_h = int(maxHeight) or store.height
+    max_h = min(max_h, store.height)
+    min_h = max(int(minHeight) or store.base, store.base)
+    min_h = max(min_h, max_h - 19)
+    metas = []
+    for h in range(max_h, min_h - 1, -1):
+        m = store.load_block_meta(h)
+        if m is not None:
+            metas.append({
+                "block_id": _block_id_json(m.block_id),
+                "block_size": str(m.block_size),
+                "header": _header_json(m.header),
+                "num_txs": str(m.num_txs),
+            })
+    return {"last_height": str(store.height), "block_metas": metas}
+
+
+def block(env, height=0):
+    store = env.node.block_store
+    h = int(height) or store.height
+    b = store.load_block(h)
+    m = store.load_block_meta(h)
+    if b is None:
+        raise ValueError(f"could not find block at height {h}")
+    return {"block_id": _block_id_json(m.block_id), "block": _block_json(b)}
+
+
+def block_by_hash(env, hash=""):
+    raw = base64.b64decode(hash) if not all(c in "0123456789abcdefABCDEF" for c in hash) else bytes.fromhex(hash)
+    b = env.node.block_store.load_block_by_hash(raw)
+    if b is None:
+        return {"block_id": None, "block": None}
+    m = env.node.block_store.load_block_meta(b.header.height)
+    return {"block_id": _block_id_json(m.block_id), "block": _block_json(b)}
+
+
+def block_results(env, height=0):
+    h = int(height) or env.node.block_store.height
+    resp = env.node.state_store.load_abci_responses(h)
+    return {
+        "height": str(h),
+        "txs_results": [
+            {"code": r.code, "data": _b64(r.data), "log": r.log,
+             "gas_wanted": str(r.gas_wanted), "gas_used": str(r.gas_used)}
+            for r in resp.deliver_txs
+        ],
+        "begin_block_events": [],
+        "end_block_events": [],
+        "validator_updates": [],
+        "consensus_param_updates": None,
+    }
+
+
+def commit(env, height=0):
+    store = env.node.block_store
+    h = int(height) or store.height
+    m = store.load_block_meta(h)
+    if m is None:
+        raise ValueError(f"could not find block meta at height {h}")
+    c = store.load_block_commit(h) or store.load_seen_commit(h)
+    return {
+        "signed_header": {"header": _header_json(m.header), "commit": _commit_json(c)},
+        "canonical": store.load_block_commit(h) is not None,
+    }
+
+
+def validators(env, height=0, page=1, per_page=30):
+    h = int(height) or env.node.block_store.height + 1
+    vals = env.node.state_store.load_validators(h)
+    page, per_page = max(int(page), 1), min(max(int(per_page), 1), 100)
+    start = (page - 1) * per_page
+    sel = vals.validators[start:start + per_page]
+    return {
+        "block_height": str(h),
+        "validators": [
+            {"address": _hex(v.address),
+             "pub_key": {"type": "tendermint/PubKeyEd25519", "value": _b64(v.pub_key.bytes())},
+             "voting_power": str(v.voting_power),
+             "proposer_priority": str(v.proposer_priority)}
+            for v in sel
+        ],
+        "count": str(len(sel)),
+        "total": str(vals.size()),
+    }
+
+
+def consensus_params(env, height=0):
+    h = int(height) or env.node.block_store.height + 1
+    params = env.node.state_store.load_consensus_params(h)
+    return {
+        "block_height": str(h),
+        "consensus_params": {
+            "block": {"max_bytes": str(params.block.max_bytes),
+                      "max_gas": str(params.block.max_gas),
+                      "time_iota_ms": str(params.block.time_iota_ms)},
+            "evidence": {"max_age_num_blocks": str(params.evidence.max_age_num_blocks),
+                         "max_age_duration": str(params.evidence.max_age_duration_ns),
+                         "max_bytes": str(params.evidence.max_bytes)},
+            "validator": {"pub_key_types": list(params.validator.pub_key_types)},
+            "version": {"app_version": str(params.version.app_version)},
+        },
+    }
+
+
+def consensus_state(env):
+    rs = env.node.consensus.rs
+    return {"round_state": {
+        "height/round/step": f"{rs.height}/{rs.round}/{rs.step}",
+        "height": str(rs.height), "round": rs.round, "step": rs.step,
+        "start_time": str(rs.start_time),
+        "proposal_block_hash": _hex(rs.proposal_block.hash()) if rs.proposal_block else "",
+        "locked_block_hash": _hex(rs.locked_block.hash()) if rs.locked_block else "",
+        "valid_block_hash": _hex(rs.valid_block.hash()) if rs.valid_block else "",
+    }}
+
+
+def dump_consensus_state(env):
+    out = consensus_state(env)
+    out["peers"] = [
+        {"node_address": p.id,
+         "peer_state": {"round_state": {
+             "height": str(ps.prs.height), "round": ps.prs.round, "step": ps.prs.step}}}
+        for p in env.node.switch.peers.values()
+        for ps in [p.get("consensus_peer_state")] if ps is not None
+    ]
+    return out
+
+
+def unconfirmed_txs(env, limit=30):
+    txs = env.node.mempool.reap_max_txs(min(int(limit), 100))
+    return {
+        "n_txs": str(len(txs)),
+        "total": str(env.node.mempool.size()),
+        "total_bytes": str(env.node.mempool.size_bytes()),
+        "txs": [_b64(t) for t in txs],
+    }
+
+
+def num_unconfirmed_txs(env):
+    return {
+        "n_txs": str(env.node.mempool.size()),
+        "total": str(env.node.mempool.size()),
+        "total_bytes": str(env.node.mempool.size_bytes()),
+        "txs": None,
+    }
+
+
+# --- tx routes --------------------------------------------------------------
+
+
+def _decode_tx_param(tx) -> bytes:
+    if isinstance(tx, bytes):
+        return tx
+    return base64.b64decode(tx)
+
+
+def broadcast_tx_async(env, tx):
+    raw = _decode_tx_param(tx)
+    import threading
+
+    threading.Thread(target=_check_tx_quiet, args=(env, raw), daemon=True).start()
+    return {"code": 0, "data": "", "log": "", "codespace": "", "hash": _hex(tx_hash(raw))}
+
+
+def _check_tx_quiet(env, raw):
+    try:
+        env.node.mempool.check_tx(raw)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def broadcast_tx_sync(env, tx):
+    raw = _decode_tx_param(tx)
+    try:
+        res = env.node.mempool.check_tx(raw)
+        return {"code": res.code, "data": _b64(res.data), "log": res.log,
+                "codespace": res.codespace, "hash": _hex(tx_hash(raw))}
+    except Exception as e:  # noqa: BLE001
+        return {"code": 1, "data": "", "log": str(e), "codespace": "mempool",
+                "hash": _hex(tx_hash(raw))}
+
+
+def broadcast_tx_commit(env, tx):
+    """Waits for the tx to be committed (reference: rpc/core/mempool.go:60)."""
+    raw = _decode_tx_param(tx)
+    q = tmevents.Query(f"{tmevents.EVENT_TYPE_KEY}='{tmevents.EVENT_TX}' AND "
+                       f"{tmevents.TX_HASH_KEY}='{_hex(tx_hash(raw))}'")
+    subscriber = f"btc-{_hex(tx_hash(raw))[:16]}"
+    sub = env.event_bus.subscribe(subscriber, q)
+    try:
+        check = env.node.mempool.check_tx(raw)
+        if not check.is_ok():
+            return {"check_tx": {"code": check.code, "log": check.log},
+                    "deliver_tx": {}, "hash": _hex(tx_hash(raw)), "height": "0"}
+        deadline = _time.monotonic() + env.node.config.rpc.timeout_broadcast_tx_commit_s
+        while _time.monotonic() < deadline:
+            msg = sub.next(timeout=0.25)
+            if msg is not None:
+                data = msg.data
+                return {
+                    "check_tx": {"code": check.code, "log": check.log},
+                    "deliver_tx": {"code": data.result.code, "log": data.result.log},
+                    "hash": _hex(tx_hash(raw)),
+                    "height": str(data.height),
+                }
+        raise TimeoutError("timed out waiting for tx to be included in a block")
+    finally:
+        try:
+            env.event_bus.unsubscribe_all(subscriber)
+        except ValueError:
+            pass
+
+
+def check_tx(env, tx):
+    raw = _decode_tx_param(tx)
+    res = env.node.app.check_tx(abci.RequestCheckTx(tx=raw))
+    return {"code": res.code, "data": _b64(res.data), "log": res.log,
+            "gas_wanted": str(res.gas_wanted), "gas_used": str(res.gas_used)}
+
+
+def tx(env, hash="", prove=False):
+    """Requires the kv indexer (reference: rpc/core/tx.go)."""
+    raw = base64.b64decode(hash) if isinstance(hash, str) else hash
+    indexer = getattr(env.node, "tx_indexer", None)
+    if indexer is None:
+        raise ValueError("transaction indexing is disabled")
+    res = indexer.get(raw)
+    if res is None:
+        raise ValueError(f"tx ({_hex(raw)}) not found")
+    return res
+
+
+def tx_search(env, query="", prove=False, page=1, per_page=30, order_by="asc"):
+    indexer = getattr(env.node, "tx_indexer", None)
+    if indexer is None:
+        raise ValueError("transaction indexing is disabled")
+    results = indexer.search(query)
+    page, per_page = max(int(page), 1), min(max(int(per_page), 1), 100)
+    start = (page - 1) * per_page
+    return {"txs": results[start:start + per_page], "total_count": str(len(results))}
+
+
+# --- abci routes ------------------------------------------------------------
+
+
+def abci_query(env, path="", data="", height=0, prove=False):
+    raw = bytes.fromhex(data) if isinstance(data, str) else data
+    res = env.node.app.query(abci.RequestQuery(data=raw, path=path,
+                                               height=int(height), prove=bool(prove)))
+    return {"response": {
+        "code": res.code, "log": res.log, "info": res.info,
+        "index": str(res.index), "key": _b64(res.key), "value": _b64(res.value),
+        "height": str(res.height), "codespace": res.codespace,
+    }}
+
+
+def abci_info(env):
+    res = env.node.app.info(abci.RequestInfo())
+    return {"response": {
+        "data": res.data, "version": res.version,
+        "app_version": str(res.app_version),
+        "last_block_height": str(res.last_block_height),
+        "last_block_app_hash": _b64(res.last_block_app_hash),
+    }}
+
+
+def broadcast_evidence(env, evidence):
+    raise ValueError("evidence must be submitted via p2p in this build")
+
+
+ROUTES = {
+    "health": health,
+    "status": status,
+    "net_info": net_info,
+    "genesis": genesis,
+    "genesis_chunked": genesis_chunked,
+    "blockchain": blockchain,
+    "block": block,
+    "block_by_hash": block_by_hash,
+    "block_results": block_results,
+    "commit": commit,
+    "validators": validators,
+    "consensus_params": consensus_params,
+    "consensus_state": consensus_state,
+    "dump_consensus_state": dump_consensus_state,
+    "unconfirmed_txs": unconfirmed_txs,
+    "num_unconfirmed_txs": num_unconfirmed_txs,
+    "broadcast_tx_async": broadcast_tx_async,
+    "broadcast_tx_sync": broadcast_tx_sync,
+    "broadcast_tx_commit": broadcast_tx_commit,
+    "check_tx": check_tx,
+    "tx": tx,
+    "tx_search": tx_search,
+    "abci_query": abci_query,
+    "abci_info": abci_info,
+    "broadcast_evidence": broadcast_evidence,
+}

@@ -1,0 +1,270 @@
+"""JSON-RPC 2.0 API server: HTTP POST + URI GET + WebSocket subscriptions
+(reference: rpc/jsonrpc/server/, rpc/core/routes.go:12-48).
+
+Routes mirror the reference's ~35-route surface; handlers live in
+tendermint_tpu.rpc.core and get the node injected (the reference's
+rpccore.Environment pattern, node/node.go:1069).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import socket
+import socketserver
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlparse
+
+from tendermint_tpu.rpc import core as rpc_core
+
+WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+def _rpc_response(id_, result=None, error: RPCError | None = None) -> bytes:
+    doc = {"jsonrpc": "2.0", "id": id_}
+    if error is not None:
+        doc["error"] = {"code": error.code, "message": error.message, "data": error.data}
+    else:
+        doc["result"] = result
+    return json.dumps(doc).encode()
+
+
+class RPCServer:
+    def __init__(self, node):
+        self.node = node
+        self.env = rpc_core.Environment(node)
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self, laddr: str) -> None:
+        host_port = laddr.split("://", 1)[-1]
+        host, port = host_port.rsplit(":", 1)
+        env = self.env
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send(self, body: bytes, content_type="application/json", code=200):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.headers.get("Upgrade", "").lower() == "websocket":
+                    self._websocket()
+                    return
+                url = urlparse(self.path)
+                method = url.path.strip("/")
+                if method == "":
+                    self._send(_index_page(env), content_type="text/plain")
+                    return
+                params = {k: _parse_uri_param(v) for k, v in parse_qsl(url.query)}
+                self._dispatch(method, params, id_=-1)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    req = json.loads(body)
+                except json.JSONDecodeError:
+                    self._send(_rpc_response(0, error=RPCError(-32700, "Parse error")))
+                    return
+                if isinstance(req, list):
+                    out = []
+                    for r in req:
+                        out.append(json.loads(self._call(
+                            r.get("method", ""), r.get("params", {}), r.get("id", 0))))
+                    self._send(json.dumps(out).encode())
+                    return
+                self._dispatch(req.get("method", ""), req.get("params", {}),
+                               req.get("id", 0))
+
+            def _dispatch(self, method, params, id_):
+                self._send(self._call(method, params, id_))
+
+            def _call(self, method, params, id_) -> bytes:
+                fn = rpc_core.ROUTES.get(method)
+                if fn is None:
+                    return _rpc_response(id_, error=RPCError(-32601, "Method not found", method))
+                try:
+                    result = fn(env, **(params or {}))
+                    return _rpc_response(id_, result=result)
+                except TypeError as e:
+                    return _rpc_response(id_, error=RPCError(-32602, "Invalid params", str(e)))
+                except Exception as e:  # noqa: BLE001
+                    return _rpc_response(id_, error=RPCError(-32603, "Internal error", str(e)))
+
+            # --- WebSocket (RFC 6455 minimal server) -----------------------
+
+            def _websocket(self):
+                key = self.headers.get("Sec-WebSocket-Key", "")
+                accept = base64.b64encode(
+                    hashlib.sha1((key + WS_GUID).encode()).digest()
+                ).decode()
+                self.send_response(101, "Switching Protocols")
+                self.send_header("Upgrade", "websocket")
+                self.send_header("Connection", "Upgrade")
+                self.send_header("Sec-WebSocket-Accept", accept)
+                self.end_headers()
+                conn = self.connection
+                subscriber = f"ws-{id(conn)}"
+                send_lock = threading.Lock()
+
+                def ws_send(payload: bytes):
+                    hdr = bytearray([0x81])
+                    n = len(payload)
+                    if n < 126:
+                        hdr.append(n)
+                    elif n < 65536:
+                        hdr.append(126)
+                        hdr += struct.pack(">H", n)
+                    else:
+                        hdr.append(127)
+                        hdr += struct.pack(">Q", n)
+                    with send_lock:
+                        conn.sendall(bytes(hdr) + payload)
+
+                subs: list = []
+                try:
+                    while True:
+                        msg = _ws_read_frame(conn)
+                        if msg is None:
+                            break
+                        try:
+                            req = json.loads(msg)
+                        except json.JSONDecodeError:
+                            continue
+                        method = req.get("method", "")
+                        params = req.get("params", {}) or {}
+                        id_ = req.get("id", 0)
+                        if method == "subscribe":
+                            query = params.get("query", "")
+                            sub = env.event_bus.subscribe(subscriber, query)
+                            subs.append((sub, query, id_))
+                            threading.Thread(
+                                target=_pump_events,
+                                args=(sub, ws_send, id_, query), daemon=True,
+                            ).start()
+                            ws_send(_rpc_response(id_, result={}))
+                        elif method == "unsubscribe":
+                            query = params.get("query", "")
+                            env.event_bus.unsubscribe(subscriber, query)
+                            ws_send(_rpc_response(id_, result={}))
+                        elif method == "unsubscribe_all":
+                            env.event_bus.unsubscribe_all(subscriber)
+                            ws_send(_rpc_response(id_, result={}))
+                        else:
+                            fn = rpc_core.ROUTES.get(method)
+                            if fn is None:
+                                ws_send(_rpc_response(id_, error=RPCError(-32601, "Method not found")))
+                            else:
+                                try:
+                                    ws_send(_rpc_response(id_, result=fn(env, **params)))
+                                except Exception as e:  # noqa: BLE001
+                                    ws_send(_rpc_response(id_, error=RPCError(-32603, "Internal error", str(e))))
+                finally:
+                    try:
+                        env.event_bus.unsubscribe_all(subscriber)
+                    except ValueError:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self.laddr = f"tcp://{host}:{self._httpd.server_port}"
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+def _pump_events(sub, ws_send, id_, query):
+    from tendermint_tpu.rpc import core as rpc_core
+
+    while True:
+        try:
+            msg = sub.next(timeout=1.0)
+        except Exception:  # noqa: BLE001
+            return
+        if msg is None:
+            if sub.cancelled:
+                return
+            continue
+        try:
+            ws_send(_rpc_response(id_, result={
+                "query": query,
+                "data": rpc_core.encode_event_data(msg.data),
+                "events": msg.events,
+            }))
+        except OSError:
+            return
+
+
+def _ws_read_frame(conn: socket.socket):
+    hdr = _read_n(conn, 2)
+    if hdr is None:
+        return None
+    b0, b1 = hdr
+    opcode = b0 & 0x0F
+    masked = b1 & 0x80
+    ln = b1 & 0x7F
+    if ln == 126:
+        ext = _read_n(conn, 2)
+        (ln,) = struct.unpack(">H", ext)
+    elif ln == 127:
+        ext = _read_n(conn, 8)
+        (ln,) = struct.unpack(">Q", ext)
+    mask = _read_n(conn, 4) if masked else b"\x00" * 4
+    payload = _read_n(conn, ln) if ln else b""
+    if payload is None:
+        return None
+    data = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+    if opcode == 0x8:  # close
+        return None
+    if opcode == 0x9:  # ping -> pong
+        conn.sendall(bytes([0x8A, len(data)]) + data)
+        return b""
+    return data
+
+
+def _read_n(conn: socket.socket, n: int):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _parse_uri_param(v: str):
+    if v.startswith('"') and v.endswith('"'):
+        return v[1:-1]
+    if v in ("true", "false"):
+        return v == "true"
+    try:
+        return int(v)
+    except ValueError:
+        return v
+
+
+def _index_page(env) -> bytes:
+    lines = ["Available endpoints:"] + sorted(f"  /{m}" for m in rpc_core.ROUTES)
+    return "\n".join(lines).encode()

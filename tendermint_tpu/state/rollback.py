@@ -1,0 +1,68 @@
+"""Rollback one height (reference: state/rollback.go:112, cmd rollback)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import replace
+
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.store.db import new_db
+
+
+class RollbackError(Exception):
+    pass
+
+
+def rollback_state(cfg) -> tuple[int, bytes]:
+    backend = cfg.base.db_backend
+    dbdir = cfg.db_dir()
+    block_store = BlockStore(new_db(backend, os.path.join(dbdir, "blockstore.db")))
+    state_store = StateStore(new_db(backend, os.path.join(dbdir, "state.db")))
+    return rollback(block_store, state_store)
+
+
+def rollback(block_store: BlockStore, state_store: StateStore) -> tuple[int, bytes]:
+    """reference: state/rollback.go Rollback."""
+    invalid_state = state_store.load()
+    if invalid_state.is_empty():
+        raise RollbackError("no state found")
+
+    height = block_store.height
+    # state and store out of sync (crash between SaveBlock and state save):
+    # the state is already where rollback would put it.
+    if height == invalid_state.last_block_height + 1:
+        return invalid_state.last_block_height, invalid_state.app_hash
+    if height != invalid_state.last_block_height:
+        raise RollbackError(
+            f"statestore height ({invalid_state.last_block_height}) is not one below or "
+            f"equal to blockstore height ({height})"
+        )
+
+    rollback_height = invalid_state.last_block_height - 1
+    if rollback_height < 1:
+        raise RollbackError("can't rollback state at genesis height")
+    rolled_back_block = block_store.load_block_meta(rollback_height)
+    if rolled_back_block is None:
+        raise RollbackError(f"block at height {rollback_height} not found")
+    latest_block = block_store.load_block_meta(invalid_state.last_block_height)
+
+    prev_validators = state_store.load_validators(rollback_height)
+    curr_validators = state_store.load_validators(rollback_height + 1)
+    next_validators = state_store.load_validators(rollback_height + 2)
+    params = state_store.load_consensus_params(rollback_height + 1)
+
+    rolled = replace(
+        invalid_state,
+        last_block_height=rollback_height,
+        last_block_id=block_store.load_block_meta(rollback_height).block_id,
+        last_block_time=rolled_back_block.header.time,
+        validators=curr_validators,
+        next_validators=next_validators,
+        last_validators=prev_validators,
+        consensus_params=params,
+        app_hash=latest_block.header.app_hash,
+        last_results_hash=rolled_back_block.header.last_results_hash,
+    )
+    state_store.save(rolled)
+    return rolled.last_block_height, rolled.app_hash

@@ -1,0 +1,153 @@
+"""RPC surface: JSON-RPC over HTTP + URI GET + WebSocket subscription."""
+
+import base64
+import json
+import os
+import time
+import urllib.request
+
+from tendermint_tpu.config.config import test_config
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.node.node import Node
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.privval.file_pv import MockPV
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.ttime import Time
+
+
+def _mk_node(tmp_path):
+    priv = ed25519.gen_priv_key(b"\x41" * 32)
+    genesis = GenesisDoc(
+        chain_id="rpc-chain", genesis_time=Time(1700003000, 0),
+        validators=[GenesisValidator(b"", priv.pub_key(), 10)],
+    )
+    cfg = test_config()
+    cfg.set_root(str(tmp_path / "node"))
+    os.makedirs(cfg.base.root_dir, exist_ok=True)
+    cfg.base.fast_sync_mode = False
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.consensus.wal_path = ""
+    return Node(cfg, genesis=genesis, priv_validator=MockPV(priv),
+                node_key=NodeKey(ed25519.gen_priv_key(b"\x42" * 32)))
+
+
+def _rpc(base, method, params=None):
+    req = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                      "params": params or {}}).encode()
+    with urllib.request.urlopen(urllib.request.Request(
+            base, data=req, headers={"Content-Type": "application/json"}),
+            timeout=10) as r:
+        doc = json.loads(r.read())
+    if "error" in doc:
+        raise RuntimeError(doc["error"])
+    return doc["result"]
+
+
+def test_rpc_surface(tmp_path):
+    node = _mk_node(tmp_path)
+    node.start()
+    base = "http://" + node.rpc_server.laddr.split("://", 1)[1]
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and node.block_store.height < 2:
+            time.sleep(0.1)
+        assert node.block_store.height >= 2
+
+        assert _rpc(base, "health") == {}
+        st = _rpc(base, "status")
+        assert int(st["sync_info"]["latest_block_height"]) >= 2
+        assert st["node_info"]["network"] == "rpc-chain"
+
+        b = _rpc(base, "block", {"height": 1})
+        assert b["block"]["header"]["height"] == "1"
+        bh = _rpc(base, "block_by_hash", {"hash": b["block_id"]["hash"]})
+        assert bh["block"]["header"]["height"] == "1"
+
+        vals = _rpc(base, "validators")
+        assert vals["total"] == "1"
+
+        ci = _rpc(base, "commit", {"height": 1})
+        assert ci["signed_header"]["commit"]["height"] == "1"
+
+        bc = _rpc(base, "blockchain")
+        assert int(bc["last_height"]) >= 2
+
+        gen = _rpc(base, "genesis")
+        assert gen["genesis"]["chain_id"] == "rpc-chain"
+
+        # broadcast_tx_commit waits for the block
+        tx = base64.b64encode(b"rpc=tx").decode()
+        res = _rpc(base, "broadcast_tx_commit", {"tx": tx})
+        assert res["deliver_tx"]["code"] == 0
+        assert int(res["height"]) > 0
+
+        # abci_query sees it after commit
+        q = _rpc(base, "abci_query", {"path": "", "data": b"rpc".hex()})
+        assert base64.b64decode(q["response"]["value"]) == b"tx"
+
+        # URI-style GET
+        with urllib.request.urlopen(f"{base}/status", timeout=10) as r:
+            doc = json.loads(r.read())
+        assert int(doc["result"]["sync_info"]["latest_block_height"]) >= 1
+
+        cs = _rpc(base, "consensus_state")
+        assert "round_state" in cs
+        ni = _rpc(base, "net_info")
+        assert ni["n_peers"] == "0"
+    finally:
+        node.stop()
+
+
+def test_websocket_subscription(tmp_path):
+    import hashlib
+    import socket
+    import struct
+
+    node = _mk_node(tmp_path)
+    node.start()
+    try:
+        host, port = node.rpc_server.laddr.split("://", 1)[1].rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=10)
+        key = base64.b64encode(b"0123456789abcdef").decode()
+        s.sendall((f"GET /websocket HTTP/1.1\r\nHost: {host}\r\n"
+                   f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                   f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+                   ).encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            resp += s.recv(4096)
+        assert b"101" in resp.split(b"\r\n")[0]
+
+        def ws_send(payload: bytes):
+            mask = b"\x01\x02\x03\x04"
+            masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+            hdr = bytes([0x81, 0x80 | len(payload)]) if len(payload) < 126 else None
+            s.sendall(hdr + mask + masked)
+
+        def ws_recv():
+            hdr = s.recv(2)
+            ln = hdr[1] & 0x7F
+            if ln == 126:
+                (ln,) = struct.unpack(">H", s.recv(2))
+            buf = b""
+            while len(buf) < ln:
+                buf += s.recv(ln - len(buf))
+            return buf
+
+        sub = json.dumps({"jsonrpc": "2.0", "id": 7, "method": "subscribe",
+                          "params": {"query": "tm.event='NewBlock'"}}).encode()
+        ws_send(sub)
+        # first reply: subscription confirmation; then block events
+        got_block = False
+        s.settimeout(30)
+        for _ in range(5):
+            doc = json.loads(ws_recv())
+            result = doc.get("result", {})
+            if result and result.get("data", {}).get("type") == "tendermint/event/NewBlock":
+                got_block = True
+                break
+        assert got_block
+        s.close()
+    finally:
+        node.stop()
