@@ -86,11 +86,46 @@ class Handshaker:
         # replay any blocks the app is missing
         if app_height < store_height:
             state = self._replay_range(state, app, app_height, store_height)
-        elif app_height > store_height:
+        elif app_height == store_height:
+            if state_height == store_height - 1:
+                # Crashed between ABCI Commit and the state save (fail-point 4):
+                # the app already executed the final block, so update the state
+                # from the saved ABCI responses WITHOUT re-executing on the real
+                # app (reference: consensus/replay.go:419-428 mock-app replay).
+                state = self._mock_replay_last_block(state, app_hash)
+        else:
             raise HandshakeError(
                 f"app block height ({app_height}) is higher than the chain ({store_height})"
             )
         return state
+
+    def _mock_replay_last_block(self, state: State, app_hash: bytes) -> State:
+        """Apply the stored ABCI responses of the final block to the state
+        without touching the app (reference: consensus/replay.go:419-428,516
+        via newMockProxyApp)."""
+        from dataclasses import replace
+
+        h = self.block_store.height
+        block = self.block_store.load_block(h)
+        meta = self.block_store.load_block_meta(h)
+        if block is None or meta is None:
+            raise HandshakeError(f"missing block at height {h} for mock replay")
+        try:
+            responses = self.state_store.load_abci_responses(h)
+        except Exception as e:
+            raise HandshakeError(
+                f"no saved ABCI responses for height {h}; cannot sync state "
+                f"without re-executing the committed block"
+            ) from e
+        sm_exec.validate_validator_updates(
+            responses.end_block.validator_updates, state.consensus_params)
+        validator_updates = sm_exec.validator_updates_from_abci(
+            responses.end_block.validator_updates)
+        new_state = sm_exec.update_state(
+            state, meta.block_id, block, responses, validator_updates)
+        new_state = replace(new_state, app_hash=app_hash)
+        self.state_store.save(new_state)
+        return new_state
 
     def _replay_range(self, state: State, app, app_height: int, store_height: int) -> State:
         """Replay blocks [app_height+1, store_height] through the app

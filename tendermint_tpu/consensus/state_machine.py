@@ -289,6 +289,13 @@ class ConsensusState:
             if mi is None:
                 try:
                     ti = self._timeout_queue.get_nowait()
+                    # WAL the timeout HERE, at dequeue time, so WAL order
+                    # matches processing order (reference consensus/state.go
+                    # writes it in receiveRoutine immediately before
+                    # handleTimeout) — writing at fire time on the ticker
+                    # thread could log it ahead of messages handled first.
+                    if self.wal is not None and not self.replay_mode:
+                        self.wal.write(timeout_wal_blob(ti), _time.time_ns())
                     self._do_handle_timeout(ti)
                     continue
                 except queue.Empty:
@@ -323,9 +330,7 @@ class ConsensusState:
                 self._handle_msg(mi)
 
     def _on_timeout_fired(self, ti: TimeoutInfo) -> None:
-        # hop onto the consensus thread
-        if self.wal is not None and not self.replay_mode:
-            self.wal.write(timeout_wal_blob(ti), _time.time_ns())
+        # hop onto the consensus thread; WAL write happens at dequeue
         self._timeout_queue.put(ti)
 
     def _handle_msg(self, mi: MsgInfo) -> None:
@@ -1023,6 +1028,16 @@ class ConsensusState:
     def _catchup_replay(self, cs_height: int) -> None:
         """Replay WAL messages from the last height boundary (reference:
         consensus/replay.go:93-160)."""
+        # Sanity: the WAL must NOT already contain an ENDHEIGHT for cs_height —
+        # that would mean the stores are behind the WAL (the height fully
+        # committed but state/block store not reflecting it), which WAL replay
+        # cannot fix (reference: consensus/replay.go:115-125).
+        done = self.wal.search_for_end_height(cs_height)
+        if done is not None:
+            raise RuntimeError(
+                f"WAL should not contain #ENDHEIGHT {cs_height}; "
+                "the state store is behind the WAL"
+            )
         after = self.wal.search_for_end_height(cs_height - 1)
         if after is None:
             # no in-height messages for this height; nothing to replay

@@ -4,12 +4,21 @@ Replaces the reference's serial per-signature loop (~70-100us/sig on one CPU
 core; reference crypto/ed25519/ed25519.go:148, called from types/vote_set.go:205
 and types/validator_set.go:685-826) with one wide SIMD verification:
 
-    host (cheap, per-sig):  size checks, S < L check, A decompress (cached per
-                            validator), h = SHA-512(R||A||msg) mod L, nibble
-                            decomposition of s and h, R byte -> limb split
-    device (the FLOPs):     R' = [s]B + [h](-A)  via shared-doubling Straus
-                            with 4-bit windows, then canonical compression and
-                            a byte-exact compare against the signature's R.
+    host (vectorized over the whole batch; ops/scalar25519, ops/chash):
+        size checks, S < L check, batched SHA-512 h = H(R||A||msg), h mod L,
+        comb-window decomposition, R byte -> limb split
+    device (the FLOPs):     R' = [s]B + [h](-A)  via a comb (Lim-Lee)
+        evaluation: 64 shared doublings + 64+64 table additions, then
+        canonical compression and a byte-exact compare against the sig's R.
+
+Comb method (t=4 teeth, d=64 columns): scalar bits split into 4 blocks of 64;
+T[w] = sum_j w_j * [2^(64j)] P for w in 0..15; evaluation
+acc <- 2*acc + T_A[wh_i] + T_B[ws_i] for i = 63..0. This quarters the
+doubling count vs per-signature Straus (256 -> 64), the dominant cost. The
+per-key tables T_A depend only on the pubkey, so they are built once per
+validator set ON DEVICE and cached in HBM across heights (steady-state
+consensus re-verifies the same keys every height); per call only the per-sig
+scalars/windows move host->device.
 
 Accept/reject is byte-identical with the scalar path (crypto/ed25519.py):
  - s >= L rejected (host);
@@ -26,16 +35,21 @@ libs/bits.BitArray vote bitmap).
 
 from __future__ import annotations
 
-import functools
-import hashlib
+import threading
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tendermint_tpu.utils import jaxcache
+
+jaxcache.enable()
+
 from tendermint_tpu.crypto import ed25519 as ref
+from tendermint_tpu.ops import chash
 from tendermint_tpu.ops import edwards25519 as ed
-from tendermint_tpu.ops import field25519 as fe
+from tendermint_tpu.ops import scalar25519 as sc
 
 L = ref.L
 P = ref.P
@@ -43,20 +57,37 @@ P = ref.P
 MIN_BUCKET = 64
 
 # ---------------------------------------------------------------------------
-# Device kernel
+# Fixed-base comb table for B (host, exact ints)
 # ---------------------------------------------------------------------------
 
-# Fixed 16-entry window table for the base point B: TAB_B[w] = w*B, extended
-# coords, built once on host with exact ints.
-def _build_base_table() -> np.ndarray:
-    pts = [(0, 1)]  # affine (x, y); identity is (0, 1)
+
+def _b_comb_affine() -> list[tuple[int, int]]:
+    """T_B[w] = sum_j w_j * [2^(64j)] B as affine points, w = 0..15."""
     base = (ref.BASE[0], ref.BASE[1])
-    for _ in range(15):
-        pts.append(ed.affine_add(pts[-1], base))
-    return np.stack([ed.from_affine(x, y) for (x, y) in pts])  # (16, 4, 20)
+    pj = [base]
+    for _ in range(3):
+        p = pj[-1]
+        for _ in range(64):
+            p = ed.affine_add(p, p)
+        pj.append(p)
+    pts = []
+    for w in range(16):
+        acc = (0, 1)
+        for j in range(4):
+            if (w >> j) & 1:
+                acc = ed.affine_add(acc, pj[j])
+        pts.append(acc)
+    return pts
 
 
-TAB_B = _build_base_table()
+_B_COMB_AFFINE = _b_comb_affine()
+# Extended-coordinate form for the jnp kernel: (16, 4, 20).
+TAB_B = np.stack([ed.from_affine(x, y) for (x, y) in _B_COMB_AFFINE])
+
+
+# ---------------------------------------------------------------------------
+# Device kernels
+# ---------------------------------------------------------------------------
 
 
 def _gather_point(table, idx):
@@ -67,12 +98,12 @@ def _gather_point(table, idx):
     return got.reshape(n, 4, 20)
 
 
-def _verify_kernel(a_neg, h_win, s_win, r_y, r_sign, valid, axis_name=None):
-    """The jitted batch verify.
+def _verify_kernel(tab, h_win, s_win, r_y, r_sign, valid, axis_name=None):
+    """The jitted batch verify (pure-jnp path; CPU fallback + shard_map body).
 
-    a_neg:  (N, 4, 20) int32   extended coords of -A (host-decompressed)
-    h_win:  (N, 64)    int32   4-bit windows of h, most-significant first
-    s_win:  (N, 64)    int32   4-bit windows of s, most-significant first
+    tab:    (N, 16, 4, 20) int32  comb table of -A per signature (cached)
+    h_win:  (N, 64)    int32   comb windows of h, processing order
+    s_win:  (N, 64)    int32   comb windows of s, processing order
     r_y:    (N, 20)    int32   raw y limbs of sig[:32] (bit 255 stripped)
     r_sign: (N,)       int32   bit 255 of sig[:32]
     valid:  (N,)       bool    host-side precheck results
@@ -80,25 +111,14 @@ def _verify_kernel(a_neg, h_win, s_win, r_y, r_sign, valid, axis_name=None):
                as device-varying; see jax shard-map scan-vma docs)
     ->      (N,)       bool
     """
-    n = a_neg.shape[0]
-
-    # Per-signature window table for -A: tab[w] = w * (-A), w = 0..15.
-    rows = [ed.identity((n,)), a_neg]
-    for w in range(2, 16):
-        if w % 2 == 0:
-            rows.append(ed.double(rows[w // 2]))
-        else:
-            rows.append(ed.add(rows[w - 1], a_neg))
-    tab_a = jnp.stack(rows, axis=1)  # (N, 16, 4, 20)
-
+    n = tab.shape[0]
     tab_b = jnp.broadcast_to(jnp.asarray(TAB_B), (n, 16, 4, 20))
 
     def body(j, acc):
-        for _ in range(4):
-            acc = ed.double(acc)
+        acc = ed.double(acc)
         wh = jax.lax.dynamic_slice_in_dim(h_win, j, 1, axis=1)[:, 0]
         ws = jax.lax.dynamic_slice_in_dim(s_win, j, 1, axis=1)[:, 0]
-        acc = ed.add(acc, _gather_point(tab_a, wh))
+        acc = ed.add(acc, _gather_point(tab, wh))
         acc = ed.add(acc, _gather_point(tab_b, ws))
         return acc
 
@@ -116,8 +136,40 @@ def _verify_kernel(a_neg, h_win, s_win, r_y, r_sign, valid, axis_name=None):
 _jnp_kernel = jax.jit(_verify_kernel)
 
 
+def _dbl64(p):
+    return jax.lax.fori_loop(0, 64, lambda _, q: ed.double(q), p)
+
+
+def _build_comb_tables_impl(a_neg):
+    """(K, 4, 20) extended -A points -> (K, 16, 4, 20) comb tables."""
+    ps = [a_neg]
+    for _ in range(3):
+        ps.append(_dbl64(ps[-1]))
+    tabs = [ed.identity((a_neg.shape[0],))]
+    for w in range(1, 16):
+        lsb = w & -w
+        j = lsb.bit_length() - 1
+        prev = w ^ lsb
+        tabs.append(ps[j] if prev == 0 else ed.add(tabs[prev], ps[j]))
+    return jnp.stack(tabs, axis=1)
+
+
+_build_comb_tables = jax.jit(_build_comb_tables_impl)
+
+
+@jax.jit
+def _gather_transpose(tab_ext, idx):
+    """(Kb, 16, 4, 20), (nb,) -> (1280, nb) lane-major per-item tables.
+
+    Gather along the MAJOR axis then transpose: a lane-axis gather is
+    pathologically slow on TPU, a row gather + transpose is fast."""
+    k = tab_ext.shape[0]
+    rows = jnp.take(tab_ext.reshape(k, 1280), idx, axis=0)  # (nb, 1280)
+    return rows.T
+
+
 # ---------------------------------------------------------------------------
-# Host-side preparation
+# Key sets: per-validator-set comb tables, device-resident across heights
 # ---------------------------------------------------------------------------
 
 _decomp_cache: dict[bytes, np.ndarray | None] = {}
@@ -139,15 +191,104 @@ def _decompress_neg(pub: bytes) -> np.ndarray | None:
     return out
 
 
-def _nibbles_msb_first(x: int) -> np.ndarray:
-    """256-bit int -> 64 4-bit windows, most significant first."""
-    b = x.to_bytes(32, "big")
-    arr = np.frombuffer(b, dtype=np.uint8)
-    out = np.empty(64, dtype=np.int32)
-    out[0::2] = arr >> 4
-    out[1::2] = arr & 15
-    return out
+class KeySet:
+    """Comb tables for an ordered multiset of pubkeys, cached on device.
 
+    `tab_ext` is (Kb, 16, 4, 20) on device (Kb = K padded to a bucket);
+    `tab_lane` is the same data in the Pallas lane-major layout (1280, Kb),
+    built lazily. `key_idx` maps item slot -> table row for the exact pubkey
+    sequence this KeySet was built from."""
+
+    __slots__ = ("n_keys", "valid", "tab_ext", "key_idx", "_gathered")
+
+    def __init__(self, n_keys, valid, tab_ext, key_idx):
+        self.n_keys = n_keys
+        self.valid = valid
+        self.tab_ext = tab_ext
+        self.key_idx = key_idx
+        self._gathered: OrderedDict = OrderedDict()
+
+    def gathered_lane(self, idx: np.ndarray):
+        """(1280, nb) lane-major comb tables for a padded index pattern,
+        cached per pattern. Steady-state commit verification reuses the same
+        (validator-order) pattern every height, so the device-side gather +
+        transpose runs once per validator set, not once per call."""
+        key = idx.tobytes()
+        hit = self._gathered.get(key)
+        if hit is not None:
+            self._gathered.move_to_end(key)
+            return hit
+        tab = _gather_transpose(self.tab_ext, jnp.asarray(idx))
+        self._gathered[key] = tab
+        while len(self._gathered) > 4:
+            self._gathered.popitem(last=False)
+        return tab
+
+
+_KS_LOCK = threading.Lock()
+_KS_CACHE: OrderedDict[bytes, KeySet] = OrderedDict()
+_KS_MAX = 8
+
+
+def next_bucket(n: int) -> int:
+    b = MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+def _normalize_pubs(pubs: list[bytes]) -> tuple[bytes, np.ndarray]:
+    """-> (joined 32-byte-normalized pubkey bytes, (N,) bool size-ok mask)."""
+    n = len(pubs)
+    ok = np.fromiter((len(p) == ref.PUBKEY_SIZE for p in pubs), dtype=bool, count=n)
+    if ok.all():
+        return b"".join(pubs), ok
+    zero = b"\x00" * 32
+    return b"".join(p if len(p) == 32 else zero for p in pubs), ok
+
+
+def get_keyset(pubs: list[bytes]) -> tuple[KeySet, np.ndarray, np.ndarray]:
+    """-> (KeySet, key_idx (N,) int32, pub_ok (N,) bool). Cached by the exact
+    pubkey byte sequence; steady-state consensus hits the cache every height."""
+    joined, pub_ok = _normalize_pubs(pubs)
+    with _KS_LOCK:
+        ks = _KS_CACHE.get(joined)
+        if ks is not None:
+            _KS_CACHE.move_to_end(joined)
+            return ks, ks.key_idx, pub_ok
+
+    # build: dedupe, decompress unique keys, build tables on device
+    n = len(pubs)
+    seen: dict[bytes, int] = {}
+    uniq: list[bytes] = []
+    key_idx = np.empty(n, dtype=np.int32)
+    for i in range(n):
+        p = joined[32 * i : 32 * i + 32]
+        j = seen.get(p)
+        if j is None:
+            j = seen[p] = len(uniq)
+            uniq.append(p)
+        key_idx[i] = j
+    kb = next_bucket(len(uniq))
+    a_neg = np.broadcast_to(ed.IDENTITY_LIMBS, (kb, 4, 20)).copy()
+    valid = np.zeros((kb,), dtype=bool)
+    for j, p in enumerate(uniq):
+        neg = _decompress_neg(p)
+        if neg is not None:
+            a_neg[j] = neg
+            valid[j] = True
+    tab_ext = _build_comb_tables(jnp.asarray(a_neg))
+    ks = KeySet(len(uniq), valid, tab_ext, key_idx)
+    with _KS_LOCK:
+        _KS_CACHE[joined] = ks
+        while len(_KS_CACHE) > _KS_MAX:
+            _KS_CACHE.popitem(last=False)
+    return ks, key_idx, pub_ok
+
+
+# ---------------------------------------------------------------------------
+# Host-side preparation (vectorized)
+# ---------------------------------------------------------------------------
 
 _BIT_W = (1 << np.arange(13, dtype=np.int64)).astype(np.int32)
 
@@ -164,48 +305,67 @@ def _r_to_limbs(r32: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return limbs.astype(np.int32), sign
 
 
-def next_bucket(n: int) -> int:
-    b = MIN_BUCKET
-    while b < n:
-        b *= 2
-    return b
+def prepare_scalars(items, pub_ok: np.ndarray):
+    """Vectorized per-signature prep: windows, R limbs, validity.
+
+    items: [(pub, msg, sig)]; pub_ok from get_keyset. Returns dict of numpy
+    arrays sized to len(items) (unpadded)."""
+    n = len(items)
+    sig_ok = np.fromiter(
+        (len(it[2]) == ref.SIGNATURE_SIZE for it in items), dtype=bool, count=n
+    )
+    if sig_ok.all():
+        sigs = np.frombuffer(b"".join(it[2] for it in items), dtype=np.uint8)
+    else:
+        zero = b"\x00" * 64
+        sigs = np.frombuffer(
+            b"".join(it[2] if len(it[2]) == 64 else zero for it in items),
+            dtype=np.uint8,
+        )
+    sigs = sigs.reshape(n, 64)
+    r32 = np.ascontiguousarray(sigs[:, :32])
+    s32 = np.ascontiguousarray(sigs[:, 32:])
+
+    pubs32, _ = _normalize_pubs([it[0] for it in items])
+    pubs_arr = np.frombuffer(pubs32, dtype=np.uint8).reshape(n, 32)
+
+    s_lt = sc.lt_l(s32)
+    digests = chash.sha512_rab(r32, np.ascontiguousarray(pubs_arr),
+                               [it[1] for it in items])
+    h32 = sc.reduce_mod_l(digests)
+    h_win = sc.comb_windows(h32)
+    s_win = sc.comb_windows(s32)
+    valid = sig_ok & s_lt & pub_ok
+    return dict(h_win=h_win, s_win=s_win, r32=r32, valid=valid)
 
 
-def prepare(items: list[tuple[bytes, bytes, bytes]]):
-    """items: [(pub, msg, sig)] -> dict of padded numpy arrays for the kernel.
+def _jnp_args(s: dict, n: int, nb: int) -> dict:
+    """prepare_scalars output -> padded (N-major, int32) args for the jnp
+    kernel: h_win, s_win, r_y, r_sign, valid."""
+    r_y, r_sign = _r_to_limbs(s["r32"])
+    out = {}
+    for k, v in (("h_win", s["h_win"].astype(np.int32)),
+                 ("s_win", s["s_win"].astype(np.int32)),
+                 ("r_y", r_y), ("r_sign", r_sign), ("valid", s["valid"])):
+        pad = np.zeros((nb,) + v.shape[1:], dtype=v.dtype)
+        pad[:n] = v
+        out[k] = pad
+    return out
 
-    Performs every check the scalar path performs before its scalar mult, so
-    entries that fail land in the `valid` mask and the device result for them
-    is ignored (they are filled with the identity / zeros)."""
+
+def prepare(items):
+    """Padded full-batch prep for the jnp kernel (compat path used by the
+    multi-chip shard harness): returns (dict incl. gathered per-item comb
+    tables, n)."""
     n = len(items)
     nb = next_bucket(n)
-    a_neg = np.zeros((nb, 4, 20), dtype=np.int32)
-    a_neg[:] = ed.IDENTITY_LIMBS
-    h_win = np.zeros((nb, 64), dtype=np.int32)
-    s_win = np.zeros((nb, 64), dtype=np.int32)
-    r32 = np.zeros((nb, 32), dtype=np.uint8)
-    valid = np.zeros((nb,), dtype=bool)
-
-    for i, (pub, msg, sig) in enumerate(items):
-        if len(pub) != ref.PUBKEY_SIZE or len(sig) != ref.SIGNATURE_SIZE:
-            continue
-        s = int.from_bytes(sig[32:], "little")
-        if s >= L:
-            continue
-        neg = _decompress_neg(pub)
-        if neg is None:
-            continue
-        h = int.from_bytes(hashlib.sha512(sig[:32] + pub + msg).digest(), "little") % L
-        a_neg[i] = neg
-        h_win[i] = _nibbles_msb_first(h)
-        s_win[i] = _nibbles_msb_first(s)
-        r32[i] = np.frombuffer(sig[:32], dtype=np.uint8)
-        valid[i] = True
-
-    r_y, r_sign = _r_to_limbs(r32)
-    return dict(
-        a_neg=a_neg, h_win=h_win, s_win=s_win, r_y=r_y, r_sign=r_sign, valid=valid
-    ), n
+    ks, key_idx, pub_ok = get_keyset([it[0] for it in items])
+    s = prepare_scalars(items, pub_ok)
+    idx = np.zeros((nb,), dtype=np.int32)
+    idx[:n] = key_idx
+    out = _jnp_args(s, n, nb)
+    out["tab"] = np.asarray(jnp.take(ks.tab_ext, jnp.asarray(idx), axis=0))
+    return out, n
 
 
 def _use_pallas() -> bool:
@@ -227,14 +387,20 @@ def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
     pure-jnp path remains as the CPU / fallback implementation."""
     if not items:
         return np.zeros((0,), dtype=bool)
-    args, n = prepare(items)
+    n = len(items)
+    ks, key_idx, pub_ok = get_keyset([it[0] for it in items])
+    s = prepare_scalars(items, pub_ok)
+
     if _use_pallas():
         from tendermint_tpu.ops import ed25519_pallas
 
-        targs = ed25519_pallas.transpose_args(args)
-        ok = ed25519_pallas.verify_kernel_pallas(
-            **{k: jnp.asarray(v) for k, v in targs.items()}
-        )
-        return np.asarray(ok)[0, :n].astype(bool)
-    ok = _jnp_kernel(**{k: jnp.asarray(v) for k, v in args.items()})
+        ok = ed25519_pallas.verify_with_keyset(ks, key_idx, s)
+        return np.asarray(ok)[:n].astype(bool)
+
+    nb = next_bucket(n)
+    idx = np.zeros((nb,), dtype=np.int32)
+    idx[:n] = key_idx
+    padded = _jnp_args(s, n, nb)
+    tab = jnp.take(ks.tab_ext, jnp.asarray(idx), axis=0)
+    ok = _jnp_kernel(tab, **{k: jnp.asarray(v) for k, v in padded.items()})
     return np.asarray(ok)[:n]

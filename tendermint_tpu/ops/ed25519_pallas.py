@@ -1,8 +1,8 @@
 """Pallas TPU kernel for batched ed25519 verification.
 
-Same math as ops/ed25519_batch (shared-window Straus, complete Edwards
-addition, canonical-encoding compare) but fused into ONE TPU kernel so the
-point state never leaves VMEM. Two layout changes vs the jnp path:
+Same math as ops/ed25519_batch._verify_kernel (comb evaluation of
+[s]B + [h](-A), canonical-encoding compare) but fused into ONE TPU kernel so
+the point state never leaves VMEM. Layout choices:
 
  * batch on the LANE axis: field elements are (20, T) int32 tiles (limb rows
    x T signatures), so every field op is a full-width VPU op. The jnp path's
@@ -11,13 +11,15 @@ point state never leaves VMEM. Two layout changes vs the jnp path:
    computes all carries at once and shifts them down one limb row (with the
    2^260 === 608 fold wrapping row 19 -> row 0). Pass counts per op are fixed
    by worst-case bound analysis (see _carry_n).
+ * per-key comb tables (16 x 4 x 20 extended points) come in as a kernel
+   INPUT (1280 rows x T lanes), gathered from the device-resident KeySet
+   cache by validator index - nothing per-key is rebuilt per call. The
+   fixed-base comb table for B is baked in as niels-form constants
+   (y+x, y-x, 2dxy), making the B addition a 7-mul mixed add.
 
 Bound discipline matches ops/field25519: all stored limbs < 9500, products
-and 20-term accumulations stay below 2^31 in int32.
-
-The per-signature window table for -A (16 points) is built in a VMEM scratch;
-the fixed-base table for B is baked into the kernel as "niels"-form constants
-(y+x, y-x, 2dxy), making the B addition a 7-mul mixed add.
+and 20-term accumulations stay below 2^31 in int32 (squaring's doubled
+cross-products included: 10 * 9500 * 19000 + 9500^2 + fold < 2^31).
 """
 
 from __future__ import annotations
@@ -30,7 +32,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from tendermint_tpu.crypto import ed25519 as ref
+from tendermint_tpu.ops import ed25519_batch as edb
 from tendermint_tpu.ops import edwards25519 as ed
 from tendermint_tpu.ops import field25519 as fe
 
@@ -44,23 +46,22 @@ _PSUB = np.asarray(fe.PSUB_LIMBS, dtype=np.int32).reshape(NLIMB, 1)
 _P_CANON = np.asarray(fe.P_LIMBS, dtype=np.int32).reshape(NLIMB, 1)
 _TWO_D = np.asarray(fe.from_int(2 * ed.D % P), dtype=np.int32).reshape(NLIMB, 1)
 
-# Fixed-base niels table: TAB_B_NIELS[w] = (y+x, y-x, 2dxy) of w*B, w=0..15.
+
+# Fixed-base niels comb table: TAB_B[w] = (y+x, y-x, 2dxy) of the comb point
+# sum_j w_j [2^(64j)] B (shared with the jnp path's extended-coordinate form).
 def _build_b_niels() -> np.ndarray:
     out = np.zeros((16, 3, NLIMB), dtype=np.int32)
-    x, y = 0, 1  # identity
-    base = (ref.BASE[0], ref.BASE[1])
-    for w in range(16):
+    for w, (x, y) in enumerate(edb._B_COMB_AFFINE):
         out[w, 0] = fe.from_int((y + x) % P)
         out[w, 1] = fe.from_int((y - x) % P)
         out[w, 2] = fe.from_int(2 * ed.D * x * y % P)
-        x, y = ed.affine_add((x, y), base)
     return out
 
 
 _TAB_B = _build_b_niels()
 
 # Pallas kernels may not capture array constants; everything per-lane-uniform
-# is packed into one (1020, 1) int32 input: rows 0-19 = 32p limbs, 20-39 =
+# is packed into one (1020, 1) int32 input: rows 0-19 = 64p limbs, 20-39 =
 # canonical p limbs, 40-59 = 2d limbs, 60-1019 = the 16x3x20 B niels table.
 CONSTS = np.concatenate(
     [_PSUB, _P_CANON, _TWO_D, _TAB_B.reshape(960, 1)], axis=0
@@ -79,7 +80,7 @@ def _carry_n(e, n: int):
     carries, shift carries down one row, fold row-19 carry into row 0 by 608.
 
     Pass counts (worst-case bound analysis, mirrors ops/field25519 docstring):
-      mul output (<= 1.94e9): 4 passes -> rows <= 8799
+      mul/sq output (<= 1.95e9): 4 passes -> rows <= 8799
       sub output (<= 25881):  2 passes -> rows <= 8799
       2x  output (<= 17598):  1 pass   -> rows <= 9407
       add output (<= 19000):  1 pass   -> rows <= 9407
@@ -89,6 +90,19 @@ def _carry_n(e, n: int):
         e = e & MASK
         e = e + jnp.concatenate([c[19:20] * FOLD, c[:19]], axis=0)
     return e
+
+
+def _fold39(conv):
+    """(39, T) convolution -> carried (20, T) via the 2^260 === 608 fold."""
+    t = conv.shape[1]
+    zrow = jnp.zeros((1, t), dtype=jnp.int32)
+    c = conv[:NLIMB]
+    d = conv[NLIMB:]
+    lo = d & MASK
+    hi = d >> 13
+    c = c + jnp.concatenate([FOLD * lo, zrow], axis=0)
+    c = c + jnp.concatenate([zrow, FOLD * hi], axis=0)
+    return _carry_n(c, 4)
 
 
 def _mul(a, b):
@@ -105,17 +119,29 @@ def _mul(a, b):
             [zrow] * i + [prod] + [zrow] * (NLIMB - 1 - i), axis=0
         )  # (39, T)
         conv = shifted if conv is None else conv + shifted
-    c = conv[:NLIMB]
-    d = conv[NLIMB:]
-    lo = d & MASK
-    hi = d >> 13
-    c = c + jnp.concatenate([FOLD * lo, zrow], axis=0)
-    c = c + jnp.concatenate([zrow, FOLD * hi], axis=0)
-    return _carry_n(c, 4)
+    return _fold39(conv)
 
 
 def _sq(a):
-    return _mul(a, a)
+    """Dedicated squaring: ~half the multiplies of _mul via doubled
+    cross-products. Bound: worst conv coeff <= 10*9500*19000 + 9500^2 =
+    1.895e9; + fold terms < 1.45e8 -> < 2.04e9 < 2^31."""
+    t = a.shape[1]
+    zrow = jnp.zeros((1, t), dtype=jnp.int32)
+    a2 = a * 2  # limbs <= 19000, no carry needed before the products
+    conv = None
+    for i in range(NLIMB):
+        # rows i+i .. i+19: a_i * [a_i, 2a_{i+1}, ..., 2a_{19}]
+        parts = [a[i : i + 1]]
+        if i + 1 < NLIMB:
+            parts.append(a2[i + 1 :])
+        row = jnp.concatenate(parts, axis=0)  # (20 - i, T)
+        prod = a[i : i + 1] * row
+        shifted = jnp.concatenate(
+            [zrow] * (2 * i) + [prod] + [zrow] * (NLIMB - 1 - i), axis=0
+        )  # (39, T)
+        conv = shifted if conv is None else conv + shifted
+    return _fold39(conv)
 
 
 def _add(a, b):
@@ -176,14 +202,14 @@ def _pt_madd_niels(p, ypx, ymx, txy2d):
 
 
 def _select16(w, table_rows):
-    """Per-lane 16-way select. w: (1, T) window index; table_rows: list of 16
-    (rows, T) arrays. Returns sum_k (w==k) * table_rows[k]."""
-    out = None
-    for k in range(16):
-        m = (w == k).astype(jnp.int32)
-        term = m * table_rows[k]
-        out = term if out is None else out + term
-    return out
+    """Per-lane 16-way select via a 4-level binary where-tree (15 selects vs
+    31 multiply-accumulate ops). w: (1, T) window index; table_rows: list of
+    16 (rows, T')-broadcastable arrays."""
+    cur = list(table_rows)
+    for bit in range(4):
+        m = ((w >> bit) & 1) != 0  # (1, T) bool
+        cur = [jnp.where(m, cur[k + 1], cur[k]) for k in range(0, len(cur), 2)]
+    return cur[0]
 
 
 def _inv(a):
@@ -247,25 +273,11 @@ def _to_canonical(a):
 # --- the kernel --------------------------------------------------------------
 
 
-def _kernel(consts_ref, a_neg_ref, h_win_ref, s_win_ref, r_y_ref, r_sv_ref, ok_ref, tab_ref):
+def _kernel(consts_ref, tab_ref, h_win_ref, s_win_ref, r_y_ref, r_sv_ref, ok_ref):
     t = TILE
     _CTX["psub"] = consts_ref[0:20, :]
     _CTX["p_canon"] = consts_ref[20:40, :]
     _CTX["two_d"] = consts_ref[40:60, :]
-
-    def pt_read(rows_ref, base):
-        return (
-            rows_ref[base : base + 20, :],
-            rows_ref[base + 20 : base + 40, :],
-            rows_ref[base + 40 : base + 60, :],
-            rows_ref[base + 60 : base + 80, :],
-        )
-
-    def pt_write(rows_ref, base, p):
-        rows_ref[base : base + 20, :] = p[0]
-        rows_ref[base + 20 : base + 40, :] = p[1]
-        rows_ref[base + 40 : base + 60, :] = p[2]
-        rows_ref[base + 60 : base + 80, :] = p[3]
 
     zero = jnp.zeros((20, t), dtype=jnp.int32)
     one = jnp.concatenate(
@@ -273,31 +285,19 @@ def _kernel(consts_ref, a_neg_ref, h_win_ref, s_win_ref, r_y_ref, r_sv_ref, ok_r
     )
     identity = (zero, one, one, zero)
 
-    # Build the per-sig window table for -A in VMEM scratch: tab[w] = w*(-A).
-    pt_write(tab_ref, 0, identity)
-    a_neg = pt_read(a_neg_ref, 0)
-    pt_write(tab_ref, 80, a_neg)
-    for w in range(2, 16):
-        if w % 2 == 0:
-            src = pt_read(tab_ref, (w // 2) * 80)
-            pt_write(tab_ref, w * 80, _pt_double(src))
-        else:
-            src = pt_read(tab_ref, (w - 1) * 80)
-            pt_write(tab_ref, w * 80, _pt_add(src, a_neg))
-
     def tab_b(k: int, f: int):
         base = 60 + (k * 3 + f) * 20
         return consts_ref[base : base + 20, :]  # (20, 1)
 
     def body(j, acc):
-        acc = _pt_double(_pt_double(_pt_double(_pt_double(acc))))
+        acc = _pt_double(acc)
         wh = h_win_ref[pl.ds(j, 1), :]  # (1, T)
         ws = s_win_ref[pl.ds(j, 1), :]
-        # gather w*(-A) from scratch (16-way select over the whole point)
+        # comb point of -A: 16-way select over the gathered per-key table
         rows = [tab_ref[k * 80 : k * 80 + 80, :] for k in range(16)]
         pa = _select16(wh, rows)
         acc = _pt_add(acc, (pa[0:20], pa[20:40], pa[40:60], pa[60:80]))
-        # gather w*B from niels constants ((20,1) broadcast over lanes)
+        # comb point of B from niels constants ((20,1) broadcast over lanes)
         ypx = _select16(ws, [tab_b(k, 0) for k in range(16)])
         ymx = _select16(ws, [tab_b(k, 1) for k in range(16)])
         txy = _select16(ws, [tab_b(k, 2) for k in range(16)])
@@ -319,10 +319,10 @@ def _kernel(consts_ref, a_neg_ref, h_win_ref, s_win_ref, r_y_ref, r_sv_ref, ok_r
     ok_ref[:, :] = ok.astype(jnp.int32)
 
 
-def _pallas_verify(a_neg, h_win, s_win, r_y, r_sv, *, interpret=False):
-    """a_neg (80,N), h_win (64,N), s_win (64,N), r_y (20,N), r_sv (2,N)
+def _pallas_verify(tab, h_win, s_win, r_y, r_sv, *, interpret=False):
+    """tab (1280,N), h_win (64,N), s_win (64,N), r_y (20,N), r_sv (2,N)
     -> ok (1, N) int32. N must be a multiple of TILE."""
-    n = a_neg.shape[1]
+    n = tab.shape[1]
     grid = (n // TILE,)
 
     def spec(rows):
@@ -335,45 +335,65 @@ def _pallas_verify(a_neg, h_win, s_win, r_y, r_sv, *, interpret=False):
         _kernel,
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
         grid=grid,
-        in_specs=[consts_spec, spec(80), spec(64), spec(64), spec(20), spec(2)],
+        in_specs=[consts_spec, spec(1280), spec(64), spec(64), spec(20), spec(2)],
         out_specs=spec(1),
-        scratch_shapes=[pltpu.VMEM((16 * 80, TILE), jnp.int32)],
         interpret=interpret,
-    )(jnp.asarray(CONSTS), a_neg, h_win, s_win, r_y, r_sv)
+    )(jnp.asarray(CONSTS), tab, h_win, s_win, r_y, r_sv)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def verify_kernel_pallas(a_neg, h_win, s_win, r_y, r_sv, interpret=False):
-    return _pallas_verify(a_neg, h_win, s_win, r_y, r_sv, interpret=interpret)
-
-
-def transpose_args(args: dict) -> dict:
-    """Convert the (N, ...) prepare() layout into the lane-major kernel layout,
-    padding N up to a TILE multiple."""
-    n = args["a_neg"].shape[0]
-    nb = ((n + TILE - 1) // TILE) * TILE
-    pad = nb - n
-
-    a_neg = args["a_neg"].reshape(n, 80).T  # (80, N)
-    h_win = args["h_win"].T
-    s_win = args["s_win"].T
-    r_y = args["r_y"].T
-    r_sv = np.stack([args["r_sign"], args["valid"].astype(np.int32)])
-
-    def padlane(x):
-        return np.pad(x, ((0, 0), (0, pad))) if pad else x
-
-    # padded lanes: a_neg rows must still be a valid point -> identity
-    a_neg = padlane(a_neg)
-    if pad:
-        ident = np.concatenate(
-            [fe.from_int(0), fe.from_int(1), fe.from_int(1), fe.from_int(0)]
-        ).reshape(80, 1)
-        a_neg[:, n:] = ident
-    return dict(
-        a_neg=np.ascontiguousarray(a_neg),
-        h_win=np.ascontiguousarray(padlane(h_win)),
-        s_win=np.ascontiguousarray(padlane(s_win)),
-        r_y=np.ascontiguousarray(padlane(r_y)),
-        r_sv=np.ascontiguousarray(padlane(r_sv)),
+def _r_limbs_device(r32):
+    """(32, N) uint8 R bytes -> ((20, N) int32 y limbs of bits 0..254,
+    (1, N) int32 sign bit). Runs on device (XLA): the host uploads raw bytes,
+    keeping the per-call H2D payload small over slow links."""
+    b = r32.astype(jnp.int32)
+    sign = b[31:32] >> 7
+    b = jnp.concatenate(
+        [b[:31], b[31:32] & 0x7F, jnp.zeros((2, b.shape[1]), jnp.int32)], axis=0
     )
+    limbs = []
+    for j in range(NLIMB):
+        k, s = divmod(13 * j, 8)
+        v = (b[k] >> s) | (b[k + 1] << (8 - s)) | (b[k + 2] << (16 - s))
+        limbs.append(v & 0x1FFF)
+    return jnp.stack(limbs), sign
+
+
+@jax.jit
+def verify_kernel_pallas(tab, h_win, s_win, r32, valid):
+    """tab (1280, N) int32 (pre-gathered comb tables, device-resident);
+    h_win/s_win (64, N) uint8; r32 (32, N) uint8; valid (1, N) uint8.
+    -> ok (1, N) int32. One upload of packed uint8 per call, one readback."""
+    hw = h_win.astype(jnp.int32)
+    sw = s_win.astype(jnp.int32)
+    r_y, sign = _r_limbs_device(r32)
+    r_sv = jnp.concatenate([sign, valid.astype(jnp.int32)], axis=0)
+    return _pallas_verify(tab, hw, sw, r_y, r_sv)
+
+
+def verify_with_keyset(ks, key_idx: np.ndarray, s: dict) -> np.ndarray:
+    """High-level entry used by ed25519_batch.verify_batch on TPU backends.
+
+    ks: ed25519_batch.KeySet; key_idx (n,) int32; s: prepare_scalars output
+    (unpadded). Returns (n,) bool."""
+    n = key_idx.shape[0]
+    nb = max(TILE, edb.next_bucket(n))
+
+    idx = np.zeros((nb,), dtype=np.int32)
+    idx[:n] = key_idx
+    tab = ks.gathered_lane(idx)  # cached per gossip/commit pattern
+
+    def padT(x, rows):
+        out = np.zeros((rows, nb), dtype=np.uint8)
+        out[:, :n] = x.T if x.ndim == 2 else x[None, :]
+        return out
+
+    h_win = padT(s["h_win"], 64)
+    s_win = padT(s["s_win"], 64)
+    r32 = padT(s["r32"], 32)
+    valid = padT(s["valid"].astype(np.uint8), 1)
+
+    ok = verify_kernel_pallas(
+        tab, jnp.asarray(h_win), jnp.asarray(s_win), jnp.asarray(r32),
+        jnp.asarray(valid),
+    )
+    return np.asarray(ok)[0, :n].astype(bool)

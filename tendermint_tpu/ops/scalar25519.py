@@ -1,0 +1,126 @@
+"""Vectorized (numpy) arithmetic mod L, the ed25519 group order.
+
+The round-1 host-prep bottleneck was a per-signature Python loop doing
+`int.from_bytes(sha512(...)) % L` and per-int window decomposition
+(~426 ms for 8k signatures). This module replaces all of it with batched
+numpy over the whole signature batch:
+
+ * `reduce_mod_l`:  (N, 64) uint8 little-endian 512-bit values -> canonical
+   (N, 32) little-endian representatives mod L, via repeated folding of the
+   identity 2^252 === -DELTA (mod L) on radix-2^21 int64 limb vectors.
+ * `comb_windows`:  (N, 32) uint8 scalars -> (N, 64) 4-bit comb windows in
+   kernel processing order (see ops/ed25519_batch for the comb evaluation).
+ * `lt_l`:          vectorized s < L canonicality check (RFC 8032 rule the
+   scalar path applies before any curve math; reference
+   crypto/ed25519/ed25519.go:148 via edwards25519.ScalarSet canonicality).
+
+L = 2^252 + DELTA where DELTA = 27742317777372353535851937790883648493.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+L = 2**252 + 27742317777372353535851937790883648493
+DELTA = L - 2**252
+
+RADIX = 21
+NLIMB = 25  # 25 * 21 = 525 >= 512 bits
+
+# DELTA < 2^125 -> 6 radix-2^21 limbs.
+DELTA_LIMBS = np.array(
+    [(DELTA >> (RADIX * i)) & ((1 << RADIX) - 1) for i in range(6)], dtype=np.int64
+)
+assert sum(int(d) << (RADIX * i) for i, d in enumerate(DELTA_LIMBS)) == DELTA
+
+_L_BYTES_BE = np.frombuffer(L.to_bytes(32, "big"), dtype=np.uint8).astype(np.int16)
+
+_BIT_W21 = (1 << np.arange(RADIX, dtype=np.int64))
+
+
+def bytes_to_limbs_t(b: np.ndarray) -> np.ndarray:
+    """(N, 64) uint8 little-endian -> (25, N) int64 radix-2^21 limbs.
+
+    Limb-major layout: every limb is a contiguous (N,) row, so the fold /
+    carry loops below run on contiguous memory."""
+    words = np.ascontiguousarray(b).view(np.uint64).T.copy()  # (8, N)
+    n = words.shape[1]
+    out = np.zeros((NLIMB, n), dtype=np.int64)
+    mask = np.uint64((1 << RADIX) - 1)
+    for j in range(NLIMB):
+        w, s = divmod(RADIX * j, 64)
+        if w >= 8:
+            break
+        v = words[w] >> np.uint64(s)
+        if s + RADIX > 64 and w + 1 < 8:
+            v = v | (words[w + 1] << np.uint64(64 - s))
+        out[j] = (v & mask).astype(np.int64)
+    return out
+
+
+def _carry_signed_t(x: np.ndarray, top: int = NLIMB) -> np.ndarray:
+    """Full floor-carry propagation on limb rows 0..top-1: rows 0..top-2 end
+    in [0, 2^21); row top-1 absorbs the (possibly negative) top residue.
+    Sequential over limbs (a negative carry must ripple all the way up in one
+    call), vectorized over the batch. Rows >= top must already be zero."""
+    carry = np.zeros(x.shape[1], dtype=np.int64)
+    for k in range(top):
+        t = x[k] + carry
+        carry = t >> RADIX  # arithmetic shift = floor division
+        x[k] = t - (carry << RADIX)
+    x[top - 1] += carry << RADIX  # value-preserving top residue
+    return x
+
+
+def reduce_mod_l(values_le: np.ndarray) -> np.ndarray:
+    """(N, 64) uint8 LE 512-bit values -> (N, 32) uint8 canonical LE mod L.
+
+    Fold loop: write v = hi * 2^252 + lo (2^252 = 2^(21*12), an exact limb
+    boundary) and replace v by lo - DELTA * hi, an exact congruence mod L.
+    Magnitude shrinks ~127 bits per fold; after 4 folds v is canonical in
+    [0, L) (range walk-through: 2^512 -> (-2^385, 2^252) -> [0, 2^258) ->
+    (-2^131, 2^252) -> [0, L))."""
+    x = bytes_to_limbs_t(values_le)
+    # (n_hi_limbs, carry_top) per fold, from the range walk-through above:
+    # fold 1 consumes 13 hi limbs (v < 2^512), later folds far fewer.
+    for nhi, top in ((13, 19), (7, 13), (1, 13), (1, 13)):
+        hi = x[12 : 12 + nhi].copy()  # signed limbs of v >> 252
+        x[12 : 12 + nhi] = 0
+        # x -= conv(DELTA_LIMBS, hi): 6 shifted vector multiplies.
+        for i in range(6):
+            x[i : i + nhi] -= DELTA_LIMBS[i] * hi
+        x = _carry_signed_t(x, top)
+    # canonical: limbs in [0, 2^21), value < L < 2^253; repack to 32 LE bytes
+    words = np.zeros((4, x.shape[1]), dtype=np.uint64)
+    ux = x[:13].astype(np.uint64)  # limbs 0..12 cover value < 2^253
+    for j in range(13):
+        w, s = divmod(RADIX * j, 64)
+        words[w] |= ux[j] << np.uint64(s)
+        if s + RADIX > 64 and w + 1 < 4:
+            words[w + 1] |= ux[j] >> np.uint64(64 - s)
+    return np.ascontiguousarray(words.T).view(np.uint8)
+
+
+def lt_l(s_le: np.ndarray) -> np.ndarray:
+    """(N, 32) uint8 little-endian -> (N,) bool: s < L."""
+    s_be = s_le[:, ::-1].astype(np.int16)
+    diff = s_be - _L_BYTES_BE  # big-endian byte-wise difference
+    nz = diff != 0
+    first = np.argmax(nz, axis=1)
+    first_diff = np.take_along_axis(diff, first[:, None], axis=1)[:, 0]
+    return np.where(nz.any(axis=1), first_diff < 0, False)
+
+
+def comb_windows(scalar_le: np.ndarray) -> np.ndarray:
+    """(N, 32) uint8 LE scalars -> (N, 64) int32 comb windows, processing
+    order (window for bit-column 63 first).
+
+    Comb(t=4, d=64): bits b_0..b_255 split into 4 blocks of 64; window
+    w_i = b_i + 2*b_{64+i} + 4*b_{128+i} + 8*b_{192+i}. Evaluation (see
+    ed25519_batch): acc <- 2*acc + T[w_i] for i = 63..0, where
+    T[w] = sum_j w_j * [2^(64j)] P."""
+    bits = np.unpackbits(np.ascontiguousarray(scalar_le), axis=1, bitorder="little")
+    w = bits[:, 0:64] + (bits[:, 64:128] << 1)  # uint8 adds; max value 15
+    w += bits[:, 128:192] << 2
+    w += bits[:, 192:256] << 3
+    return np.ascontiguousarray(w[:, ::-1])  # uint8: H2D stays small

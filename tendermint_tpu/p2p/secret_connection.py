@@ -1,13 +1,18 @@
 """SecretConnection: authenticated encryption for the peer wire
 (reference: p2p/conn/secret_connection.go:63,92,139-143).
 
-Same STS construction as the reference:
+STS-shaped construction, v0.33-style key schedule — NOT wire-interoperable
+with reference v0.34 nodes (which derive the auth challenge from a Merlin
+transcript, secret_connection.go:92-143); framework peers interoperate with
+each other:
  1. exchange ephemeral X25519 pubkeys (32 bytes, length-delimited);
- 2. DH -> shared secret; HKDF-SHA256 expand to 64 bytes of send/recv keys
+ 2. DH -> shared secret; HKDF-SHA256 expand to 96 bytes: send/recv keys
     (ordering by lexicographic comparison of the ephemeral pubkeys) plus a
-    32-byte challenge transcript hash;
+    32-byte challenge (okm[64:96], in place of the reference's Merlin
+    transcript challenge);
  3. all further traffic in ChaCha20-Poly1305 sealed frames: 4-byte LE length
-    + payload, padded to 1024 bytes, 12-byte LE counter nonces per direction;
+    + payload, padded to 1024 bytes; 12-byte nonce with a LE u64 counter in
+    bytes [4:12) per direction (same layout as secret_connection.go:455-463);
  4. exchange (node ed25519 pubkey, sig over challenge) inside the encrypted
     channel and verify.
 """
@@ -131,14 +136,14 @@ class SecretConnection:
                 pos += len(chunk)
                 frame = struct.pack("<I", len(chunk)) + chunk
                 frame += b"\x00" * (FRAME_SIZE - len(frame))
-                nonce = struct.pack("<Q", self._send_nonce) + b"\x00" * 4
+                nonce = b"\x00" * 4 + struct.pack("<Q", self._send_nonce)
                 self._send_nonce += 1
                 sealed = self._send_aead.encrypt(nonce, frame, None)
                 self._sock.sendall(sealed)
 
     def _read_frame(self) -> bytes:
         sealed = _read_exact(self._sock, SEALED_FRAME_SIZE)
-        nonce = struct.pack("<Q", self._recv_nonce) + b"\x00" * 4
+        nonce = b"\x00" * 4 + struct.pack("<Q", self._recv_nonce)
         self._recv_nonce += 1
         try:
             frame = self._recv_aead.decrypt(nonce, sealed, None)

@@ -27,9 +27,9 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(np.array(devices, dtype=object).reshape(-1), ("dp",))
 
 
-def _local_verify_tally(a_neg, h_win, s_win, r_y, r_sign, valid, power, for_block):
+def _local_verify_tally(tab, h_win, s_win, r_y, r_sign, valid, power, for_block):
     ok = ed25519_batch._verify_kernel(
-        a_neg, h_win, s_win, r_y, r_sign, valid, axis_name="dp"
+        tab, h_win, s_win, r_y, r_sign, valid, axis_name="dp"
     )
     # Tally voting power of passing, block-committing signatures; psum over
     # the device mesh so every chip holds the global tally.

@@ -1,0 +1,42 @@
+"""Persistent XLA compilation cache.
+
+The batch-verify kernels compile in O(30s) cold (CPU backend is worse); a
+node must not pay that on every restart, and the test suite must not pay it
+on every run. jax's persistent compilation cache stores serialized
+executables keyed by HLO fingerprint; enabling it makes every compile after
+the first process-lifetime instantaneous.
+
+Called from ops/ed25519_batch import (any process that might touch a kernel)
+and from tests/conftest.py. No-op if the user set their own cache config or
+TM_TPU_JAX_CACHE=0.
+"""
+
+from __future__ import annotations
+
+import os
+
+_done = False
+
+
+def enable() -> None:
+    global _done
+    if _done:
+        return
+    _done = True
+    if os.environ.get("TM_TPU_JAX_CACHE", "1") == "0":
+        return
+    import jax
+
+    if jax.config.jax_compilation_cache_dir:
+        return  # user already configured one
+    cache_dir = os.environ.get(
+        "TM_TPU_JAX_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "tendermint_tpu", "jax"),
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # noqa: BLE001 - cache is an optimization, never fatal
+        pass
