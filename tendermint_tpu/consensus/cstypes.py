@@ -112,7 +112,7 @@ class HeightVoteSet:
                     self._add_round(r)
             self.round = round_
 
-    def add_vote(self, vote: Vote, peer_id: str) -> bool:
+    def add_vote(self, vote: Vote, peer_id: str, verified: bool = False) -> bool:
         """reference: height_vote_set.go:117-150."""
         with self._mtx:
             if not is_vote_type_valid(vote.type):
@@ -127,7 +127,7 @@ class HeightVoteSet:
                     self.peer_catchup_rounds[peer_id] = rndz
                 else:
                     raise ErrGotVoteFromUnwantedRound()
-            return vote_set.add_vote(vote)
+            return vote_set.add_vote(vote, verified=verified)
 
     def prevotes(self, round_: int) -> VoteSet | None:
         with self._mtx:

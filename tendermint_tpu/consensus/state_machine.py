@@ -183,6 +183,7 @@ class ConsensusState:
         self._ticker = TimeoutTicker(self._on_timeout_fired)
         self._timeout_queue: queue.Queue = queue.Queue()
         self._mtx = threading.RLock()
+        self._holdover: object | None = None  # non-vote msg dequeued mid-drain
         self._thread: threading.Thread | None = None
         self._running = False
         self.replay_mode = False
@@ -300,10 +301,13 @@ class ConsensusState:
                     continue
                 except queue.Empty:
                     pass
-                try:
-                    mi = self._msg_queue.get(timeout=0.02)
-                except queue.Empty:
-                    continue
+                if self._holdover is not None:
+                    mi, self._holdover = self._holdover, None
+                else:
+                    try:
+                        mi = self._msg_queue.get(timeout=0.02)
+                    except queue.Empty:
+                        continue
             if mi is None:
                 return  # stop sentinel
             if isinstance(mi, tuple):
@@ -317,6 +321,22 @@ class ConsensusState:
                     with self._mtx:
                         self._handle_txs_available()
                 continue
+            # Batched vote drain (the deferred batched addVote mode the
+            # reference lacks; BASELINE config 5): when peer votes have piled
+            # up, pull them all and verify their signatures in ONE
+            # BatchVerifier flush instead of one scalar verify per vote.
+            if (not internal and isinstance(mi.msg, VoteMessage)
+                    and not self._msg_queue.empty()):
+                votes = self._drain_votes(mi)
+                if len(votes) > 1:
+                    if self.wal is not None and not self.replay_mode:
+                        for m in votes:
+                            blob = m.msg.wal_blob()
+                            blob.peer_id = m.peer_id
+                            self.wal.write(blob, _time.time_ns())
+                    with self._mtx:
+                        self._handle_vote_batch(votes)
+                    continue
             # WAL discipline (reference: state.go:753-780): internal messages
             # are fsync'd, peer messages buffered.
             if self.wal is not None and not self.replay_mode:
@@ -328,6 +348,79 @@ class ConsensusState:
                     self.wal.write(blob, _time.time_ns())
             with self._mtx:
                 self._handle_msg(mi)
+
+    def _drain_votes(self, first: MsgInfo) -> list[MsgInfo]:
+        """Pull immediately-available peer VoteMessages (bounded so internal
+        messages and timeouts are not starved). A non-vote message ends the
+        drain and is held over for the next loop iteration."""
+        batch = [first]
+        while len(batch) < 1024:
+            try:
+                nxt = self._msg_queue.get_nowait()
+            except queue.Empty:
+                break
+            if isinstance(nxt, MsgInfo) and isinstance(nxt.msg, VoteMessage):
+                batch.append(nxt)
+            else:
+                self._holdover = nxt
+                break
+        return batch
+
+    def _handle_vote_batch(self, msgs: list[MsgInfo]) -> None:
+        """Verify the batch's signatures in one BatchVerifier flush, then
+        apply each vote IN ARRIVAL ORDER through the normal addVote path with
+        the signature check skipped. Per-vote side effects (conflict/evidence
+        detection, maj23 bookkeeping, round transitions) are bit-identical to
+        serial processing: the batch verifies exactly the triple
+        (val_set[index].pub_key, sign_bytes(chain_id), signature) that
+        VoteSet.add_vote would check (reference: types/vote_set.go:205)."""
+        from tendermint_tpu.crypto import batch as crypto_batch
+
+        rs = self.rs
+        val_set = rs.votes.val_set if rs.votes is not None else None
+        height = rs.height
+        ok_by_i: dict[int, bool] = {}
+        try:
+            verifier = crypto_batch.create_batch_verifier()
+            queued: list[int] = []
+            for i, m in enumerate(msgs):
+                v = m.msg.vote
+                if val_set is None or v.height != height:
+                    continue  # serial path handles late/early votes
+                if not (0 <= v.validator_index < val_set.size()):
+                    continue  # precheck will raise the right error serially
+                addr, val = val_set.get_by_index(v.validator_index)
+                if val is None or addr != v.validator_address:
+                    continue
+                verifier.add(val.pub_key, v.sign_bytes(self.state.chain_id),
+                             v.signature)
+                queued.append(i)
+            if queued:
+                _, bitmap = verifier.verify()
+                ok_by_i = dict(zip(queued, bitmap))
+        except Exception as e:  # noqa: BLE001
+            # A flush failure (device OOM, runtime hiccup) must not kill the
+            # consensus thread; fall back to per-vote scalar verification.
+            ok_by_i = {}
+            if self.logger is not None:
+                self.logger.error("batched vote verify failed; falling back "
+                                  "to serial", err=e)
+        for i, m in enumerate(msgs):
+            ok = ok_by_i.get(i)
+            if ok is False:
+                # Same terminal state as the serial path's VoteError: vote
+                # dropped, error logged, consensus thread lives on.
+                if self.logger is not None:
+                    self.logger.error(
+                        "failed to process message", err="invalid signature",
+                        peer=m.peer_id)
+                continue
+            try:
+                self._try_add_vote(m.msg.vote, m.peer_id, verified=bool(ok))
+            except Exception as e:  # noqa: BLE001 - mirror _handle_msg
+                if self.logger is not None:
+                    self.logger.error("failed to process message", err=e,
+                                      peer=m.peer_id)
 
     def _on_timeout_fired(self, ti: TimeoutInfo) -> None:
         # hop onto the consensus thread; WAL write happens at dequeue
@@ -873,10 +966,10 @@ class ConsensusState:
 
     # --- votes --------------------------------------------------------------
 
-    def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+    def _try_add_vote(self, vote: Vote, peer_id: str, verified: bool = False) -> bool:
         """reference: consensus/state.go:1947-1995."""
         try:
-            return self._add_vote(vote, peer_id)
+            return self._add_vote(vote, peer_id, verified=verified)
         except ErrVoteConflictingVotes as e:
             if self.priv_validator_pub_key is not None and (
                     vote.validator_address == self.priv_validator_pub_key.address()):
@@ -885,7 +978,7 @@ class ConsensusState:
                 self.evpool.report_conflicting_votes(e.vote_a, e.vote_b)
             return getattr(e, "added", False)
 
-    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+    def _add_vote(self, vote: Vote, peer_id: str, verified: bool = False) -> bool:
         """reference: consensus/state.go:1995-2168."""
         rs = self.rs
 
@@ -895,7 +988,7 @@ class ConsensusState:
                 return False
             if rs.last_commit is None:
                 return False
-            added = rs.last_commit.add_vote(vote)
+            added = rs.last_commit.add_vote(vote, verified=verified)
             if not added:
                 return False
             self.event_bus.publish_event_vote(tmevents.EventDataVote(vote=vote))
@@ -909,7 +1002,7 @@ class ConsensusState:
             return False
 
         height = rs.height
-        added = rs.votes.add_vote(vote, peer_id)
+        added = rs.votes.add_vote(vote, peer_id, verified=verified)
         if not added:
             return False
         self.event_bus.publish_event_vote(tmevents.EventDataVote(vote=vote))

@@ -56,8 +56,20 @@ class ScalarBatchVerifier(BatchVerifier):
         return len(self._items)
 
 
+def batch_min() -> int:
+    """Batch-size threshold below which the kernel is never launched.
+
+    A 1-vote commit (single-validator chains, gossiped singles) must not pay
+    kernel dispatch -- and on a cold process must not pay XLA compilation.
+    The scalar python path verifies one sig in ~1-3 ms; the crossover vs a
+    warm kernel launch sits in the tens of signatures."""
+    v = os.environ.get("TM_TPU_BATCH_MIN")
+    return int(v) if v else 32
+
+
 class Ed25519BatchVerifier(BatchVerifier):
-    """TPU-batched ed25519 (tendermint_tpu.ops.ed25519_batch)."""
+    """TPU-batched ed25519 (tendermint_tpu.ops.ed25519_batch), with a scalar
+    fallback for batches too small to amortize a kernel launch."""
 
     def __init__(self) -> None:
         self._items: list[tuple[bytes, bytes, bytes]] = []
@@ -66,10 +78,15 @@ class Ed25519BatchVerifier(BatchVerifier):
         self._items.append((pub_key.bytes(), msg, sig))
 
     def verify(self) -> tuple[bool, list[bool]]:
+        items, self._items = self._items, []
+        if len(items) < batch_min():
+            from tendermint_tpu.crypto import ed25519
+
+            out = [ed25519.verify(p, m, s) for (p, m, s) in items]
+            return all(out), out
         from tendermint_tpu.ops import ed25519_batch
 
-        bitmap = ed25519_batch.verify_batch(self._items)
-        self._items = []
+        bitmap = ed25519_batch.verify_batch(items)
         out = [bool(b) for b in bitmap]
         return all(out), out
 
@@ -104,6 +121,45 @@ class MixedBatchVerifier(BatchVerifier):
 
     def __len__(self) -> int:
         return len(self._order)
+
+
+_WARMED = False
+
+
+def warmup(sizes: tuple[int, ...] = (64,), background: bool = True):
+    """AOT-warm the batch kernel at the given bucket sizes.
+
+    XLA compiles one executable per padded bucket shape; the first launch at a
+    new bucket pays ~20-40 s of tracing+compilation. Nodes call this at start
+    (in a background thread by default) so the first real commit at a warm
+    bucket size is a cache hit, not a compile. No-op when batching is disabled
+    or already warmed. Returns the warmup thread when background, else None."""
+    global _WARMED
+    if _WARMED or os.environ.get("TM_TPU_DISABLE_BATCH") == "1":
+        return None
+    _WARMED = True
+
+    def _run():
+        try:
+            from tendermint_tpu.crypto import ed25519
+            from tendermint_tpu.ops import ed25519_batch
+
+            priv = ed25519.gen_priv_key(b"\x42" * 32)
+            pub = priv.pub_key().bytes()
+            sig = ed25519.sign(priv.data, b"warmup")
+            for n in sizes:
+                ed25519_batch.verify_batch([(pub, b"warmup", sig)] * n)
+        except Exception:  # noqa: BLE001 - warmup must never kill a node
+            return
+
+    if background:
+        import threading
+
+        t = threading.Thread(target=_run, name="batch-warmup", daemon=True)
+        t.start()
+        return t
+    _run()
+    return None
 
 
 _BATCH_TYPES: dict[str, type] = {}

@@ -151,6 +151,12 @@ class Node:
     # --- lifecycle (reference: node/node.go:941 OnStart) -------------------
 
     def start(self) -> None:
+        # AOT-warm the batch-verify kernel off the critical path so the first
+        # real commit at a warm bucket size is a compile-cache hit
+        # (reference has no analogue; XLA compilation is TPU-build-specific).
+        from tendermint_tpu.crypto import batch as crypto_batch
+
+        crypto_batch.warmup()
         if self.config.p2p.laddr:
             self.transport.listen(self.config.p2p.laddr)
         self.switch.start()

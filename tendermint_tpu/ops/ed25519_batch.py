@@ -35,6 +35,7 @@ libs/bits.BitArray vote bitmap).
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 
@@ -140,21 +141,62 @@ def _dbl64(p):
     return jax.lax.fori_loop(0, 64, lambda _, q: ed.double(q), p)
 
 
+# Comb-table recurrence indices: T[w] = T[w ^ lsb(w)] + ps[log2(lsb(w))].
+# Rolled into a fori_loop (one traced point-add instead of 15) because the
+# unified Edwards formula is complete: T[0] = identity participates safely.
+_COMB_PREV = np.array([w ^ (w & -w) for w in range(16)], dtype=np.int32)
+_COMB_J = np.array(
+    [max((w & -w).bit_length() - 1, 0) for w in range(16)], dtype=np.int32
+)
+
+
 def _build_comb_tables_impl(a_neg):
     """(K, 4, 20) extended -A points -> (K, 16, 4, 20) comb tables."""
-    ps = [a_neg]
-    for _ in range(3):
-        ps.append(_dbl64(ps[-1]))
-    tabs = [ed.identity((a_neg.shape[0],))]
-    for w in range(1, 16):
-        lsb = w & -w
-        j = lsb.bit_length() - 1
-        prev = w ^ lsb
-        tabs.append(ps[j] if prev == 0 else ed.add(tabs[prev], ps[j]))
-    return jnp.stack(tabs, axis=1)
+    k = a_neg.shape[0]
+    ps0 = jnp.zeros((4, k, 4, 20), jnp.int32).at[0].set(a_neg)
+    ps = jax.lax.fori_loop(
+        0, 3, lambda j, ps: ps.at[j + 1].set(_dbl64(ps[j])), ps0
+    )
+    prev = jnp.asarray(_COMB_PREV)
+    jj = jnp.asarray(_COMB_J)
+
+    def body(w, tab):
+        p = jnp.take(tab, prev[w], axis=1)
+        return tab.at[:, w].set(ed.add(p, ps[jj[w]]))
+
+    tab0 = (
+        jnp.zeros((k, 16, 4, 20), jnp.int32)
+        .at[:, 0].set(ed.identity((k,)))
+    )
+    return jax.lax.fori_loop(1, 16, body, tab0)
 
 
 _build_comb_tables = jax.jit(_build_comb_tables_impl)
+
+# Fixed compile shapes: XLA compiles one executable per input shape, and a
+# cold compile of these limb-heavy graphs is O(30-100 s). Chunking every
+# batch through ONE (tile-sized) executable makes compilation a one-time
+# cost per process regardless of batch size.
+KEY_TILE = int(os.environ.get("TM_TPU_KEY_TILE", "256"))
+JNP_TILE = int(os.environ.get("TM_TPU_JNP_TILE", "256"))
+
+
+def _build_comb_tables_tiled(a_neg: np.ndarray):
+    """(K, 4, 20) -> (ceil(K/KEY_TILE)*KEY_TILE, 16, 4, 20), built in
+    fixed-shape chunks so _build_comb_tables compiles exactly once."""
+    k = a_neg.shape[0]
+    kp = max(_round_up(k, KEY_TILE), KEY_TILE)
+    padded = np.broadcast_to(ed.IDENTITY_LIMBS, (kp, 4, 20)).copy()
+    padded[:k] = a_neg
+    chunks = [
+        _build_comb_tables(jnp.asarray(padded[o : o + KEY_TILE]))
+        for o in range(0, kp, KEY_TILE)
+    ]
+    return chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks, axis=0)
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
 
 
 @jax.jit
@@ -220,7 +262,9 @@ class KeySet:
             return hit
         tab = _gather_transpose(self.tab_ext, jnp.asarray(idx))
         self._gathered[key] = tab
-        while len(self._gathered) > 4:
+        # Large batches dispatch in fixed CHUNK slices (ed25519_pallas), so a
+        # steady-state 20k-sig commit needs ~5-8 resident chunk patterns.
+        while len(self._gathered) > 16:
             self._gathered.popitem(last=False)
         return tab
 
@@ -269,15 +313,14 @@ def get_keyset(pubs: list[bytes]) -> tuple[KeySet, np.ndarray, np.ndarray]:
             j = seen[p] = len(uniq)
             uniq.append(p)
         key_idx[i] = j
-    kb = next_bucket(len(uniq))
-    a_neg = np.broadcast_to(ed.IDENTITY_LIMBS, (kb, 4, 20)).copy()
-    valid = np.zeros((kb,), dtype=bool)
+    a_neg = np.broadcast_to(ed.IDENTITY_LIMBS, (len(uniq), 4, 20)).copy()
+    valid = np.zeros((max(_round_up(len(uniq), KEY_TILE), KEY_TILE),), dtype=bool)
     for j, p in enumerate(uniq):
         neg = _decompress_neg(p)
         if neg is not None:
             a_neg[j] = neg
             valid[j] = True
-    tab_ext = _build_comb_tables(jnp.asarray(a_neg))
+    tab_ext = _build_comb_tables_tiled(a_neg)
     ks = KeySet(len(uniq), valid, tab_ext, key_idx)
     with _KS_LOCK:
         _KS_CACHE[joined] = ks
@@ -360,6 +403,10 @@ def prepare(items):
     n = len(items)
     nb = next_bucket(n)
     ks, key_idx, pub_ok = get_keyset([it[0] for it in items])
+    # Keys that failed decompression sit in the table as the identity point;
+    # without this mask a forged (R = compress([s]B), s) pair would verify
+    # under any off-curve pubkey (the scalar path rejects these).
+    pub_ok = pub_ok & ks.valid[key_idx]
     s = prepare_scalars(items, pub_ok)
     idx = np.zeros((nb,), dtype=np.int32)
     idx[:n] = key_idx
@@ -389,6 +436,9 @@ def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
         return np.zeros((0,), dtype=bool)
     n = len(items)
     ks, key_idx, pub_ok = get_keyset([it[0] for it in items])
+    # Non-decompressable keys get an identity comb table; they must be
+    # rejected here, exactly as the scalar path's _decompress(pub) is None.
+    pub_ok = pub_ok & ks.valid[key_idx]
     s = prepare_scalars(items, pub_ok)
 
     if _use_pallas():
@@ -397,10 +447,17 @@ def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
         ok = ed25519_pallas.verify_with_keyset(ks, key_idx, s)
         return np.asarray(ok)[:n].astype(bool)
 
-    nb = next_bucket(n)
+    # Fixed-tile chunking: every batch runs through the one JNP_TILE-shaped
+    # executable, so no batch size ever triggers a fresh XLA compile.
+    nb = max(_round_up(n, JNP_TILE), JNP_TILE)
     idx = np.zeros((nb,), dtype=np.int32)
     idx[:n] = key_idx
     padded = _jnp_args(s, n, nb)
-    tab = jnp.take(ks.tab_ext, jnp.asarray(idx), axis=0)
-    ok = _jnp_kernel(tab, **{k: jnp.asarray(v) for k, v in padded.items()})
+    outs = []
+    for off in range(0, nb, JNP_TILE):
+        tab = jnp.take(ks.tab_ext, jnp.asarray(idx[off : off + JNP_TILE]), axis=0)
+        outs.append(_jnp_kernel(tab, **{
+            k: jnp.asarray(v[off : off + JNP_TILE]) for k, v in padded.items()
+        }))
+    ok = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
     return np.asarray(ok)[:n]

@@ -370,17 +370,25 @@ def verify_kernel_pallas(tab, h_win, s_win, r32, valid):
     return _pallas_verify(tab, hw, sw, r_y, r_sv)
 
 
+# Fixed dispatch shape: XLA compiles one executable per input shape, so the
+# pallas call always runs at a multiple of CHUNK lanes (small batches pad to
+# one CHUNK; large ones loop). A fresh batch size must never trigger a cold
+# compile inside the consensus loop.
+import os as _os
+
+CHUNK = int(_os.environ.get("TM_TPU_PALLAS_CHUNK", str(16 * TILE)))  # 4096
+
+
 def verify_with_keyset(ks, key_idx: np.ndarray, s: dict) -> np.ndarray:
     """High-level entry used by ed25519_batch.verify_batch on TPU backends.
 
     ks: ed25519_batch.KeySet; key_idx (n,) int32; s: prepare_scalars output
     (unpadded). Returns (n,) bool."""
     n = key_idx.shape[0]
-    nb = max(TILE, edb.next_bucket(n))
+    nb = -(-n // CHUNK) * CHUNK
 
     idx = np.zeros((nb,), dtype=np.int32)
     idx[:n] = key_idx
-    tab = ks.gathered_lane(idx)  # cached per gossip/commit pattern
 
     def padT(x, rows):
         out = np.zeros((rows, nb), dtype=np.uint8)
@@ -392,8 +400,13 @@ def verify_with_keyset(ks, key_idx: np.ndarray, s: dict) -> np.ndarray:
     r32 = padT(s["r32"], 32)
     valid = padT(s["valid"].astype(np.uint8), 1)
 
-    ok = verify_kernel_pallas(
-        tab, jnp.asarray(h_win), jnp.asarray(s_win), jnp.asarray(r32),
-        jnp.asarray(valid),
-    )
+    outs = []
+    for off in range(0, nb, CHUNK):
+        sl = slice(off, off + CHUNK)
+        tab = ks.gathered_lane(idx[sl])  # cached per gossip/commit pattern
+        outs.append(verify_kernel_pallas(
+            tab, jnp.asarray(h_win[:, sl]), jnp.asarray(s_win[:, sl]),
+            jnp.asarray(r32[:, sl]), jnp.asarray(valid[:, sl]),
+        ))
+    ok = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
     return np.asarray(ok)[0, :n].astype(bool)

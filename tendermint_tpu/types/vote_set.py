@@ -72,16 +72,20 @@ class VoteSet:
 
     # --- adding votes ------------------------------------------------------
 
-    def add_vote(self, vote: Vote | None) -> bool:
+    def add_vote(self, vote: Vote | None, verified: bool = False) -> bool:
         """Returns True if added (False: duplicate). Raises on invalid
-        (reference: types/vote_set.go:145-230)."""
+        (reference: types/vote_set.go:145-230).
+
+        verified=True skips the signature check: the caller already verified
+        this exact (val_set[index].pub_key, sign_bytes, signature) triple
+        through a BatchVerifier flush (the deferred batched mode)."""
         if vote is None:
             raise VoteSetError("nil vote")
         checked = self._precheck(vote)
         if checked is None:
             return False  # exact duplicate
         val = checked
-        if not val.pub_key.verify_signature(
+        if not verified and not val.pub_key.verify_signature(
             vote.sign_bytes(self.chain_id), vote.signature
         ):
             raise VoteError(

@@ -110,6 +110,28 @@ def test_small_order_pubkey_signatures():
     _check(items)
 
 
+def test_forged_sig_under_invalid_pubkey():
+    """Regression (round-3 advisor finding): a non-decompressable pubkey gets
+    an identity comb table, so R' = [s]B; a crafted sig with R = compress([s]B)
+    would verify under ANY off-curve key unless key validity is folded into
+    the item mask. The scalar path rejects at _decompress(pub) is None."""
+    bad_pubs = [
+        (5).to_bytes(32, "little"),            # y not on curve
+        ref.P.to_bytes(32, "little"),          # y >= p
+        (1 | (1 << 255)).to_bytes(32, "little"),  # x=0 with sign bit
+    ]
+    items = []
+    for i, bad in enumerate(bad_pubs):
+        s = (i + 2) * 12345 % ref.L
+        r_bytes = ref._compress(ref._scalarmult(s, ref.BASE))
+        forged = r_bytes + s.to_bytes(32, "little")
+        for msg in (b"", b"any message %d" % i):
+            items.append((bad, msg, forged))
+    got = batch.verify_batch(items)
+    assert not got.any(), "forged sig accepted under invalid pubkey"
+    _check(items)
+
+
 def test_large_batch_with_padding():
     """Crosses a bucket boundary (70 -> padded 128)."""
     items = []
