@@ -45,14 +45,50 @@ def split_point(n: int) -> int:
     return k if k < n else k >> 1
 
 
+# Below this many items the recursive hashlib path wins (no FFI/array setup).
+_BATCH_THRESHOLD = 64
+
+
 def hash_from_byte_slices(items: list[bytes]) -> bytes:
     n = len(items)
     if n == 0:
         return empty_hash()
     if n == 1:
         return leaf_hash(items[0])
+    if n >= _BATCH_THRESHOLD:
+        return _hash_from_byte_slices_batched(items)
     k = split_point(n)
     return inner_hash(hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:]))
+
+
+def _hash_from_byte_slices_batched(items: list[bytes]) -> bytes:
+    """Level-order batched evaluation of the RFC-6962 tree.
+
+    The reference shape (split at the largest power of two < n,
+    crypto/merkle/tree.go getSplitPoint) is identical to repeatedly pairing
+    adjacent nodes left-to-right and promoting a trailing odd node unchanged,
+    so every level is one fixed-width SHA-256 batch through csrc/hash_batch.c
+    (sha256_batch_fixed) instead of n-1 hashlib calls.
+    """
+    import numpy as np
+
+    from tendermint_tpu.ops import chash
+
+    level = chash.sha256_many([LEAF_PREFIX + it for it in items])
+    prefix = np.frombuffer(INNER_PREFIX, dtype=np.uint8)
+    while len(level) > 1:
+        n = len(level)
+        pairs = n // 2
+        rows = np.empty((pairs, 65), dtype=np.uint8)
+        rows[:, 0] = prefix[0]
+        rows[:, 1:33] = level[0 : 2 * pairs : 2]
+        rows[:, 33:65] = level[1 : 2 * pairs : 2]
+        hashed = chash.sha256_fixed(rows)
+        if n % 2:
+            level = np.concatenate([hashed, level[n - 1 :]], axis=0)
+        else:
+            level = hashed
+    return level[0].tobytes()
 
 
 @dataclass

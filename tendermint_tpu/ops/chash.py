@@ -1,10 +1,11 @@
 """Batched hashing: ctypes bindings for csrc/hash_batch.c with a hashlib
 fallback.
 
-The shared library is built lazily with g++ on first use (cached next to the
-source; rebuilt when the source is newer). All entry points take/return numpy
-arrays so a 20k-signature commit pays ONE FFI crossing instead of 20k hashlib
-calls.
+The shared library is built lazily with g++ on first use. The output filename
+embeds a content hash of the C sources, so a stale binary can never be loaded
+silently (and no binary artifact is committed — csrc/*.so is gitignored). All
+entry points take/return numpy arrays so a 20k-signature commit pays ONE FFI
+crossing instead of 20k hashlib calls.
 """
 
 from __future__ import annotations
@@ -18,8 +19,17 @@ import threading
 import numpy as np
 
 _CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
-_LIB_PATH = os.path.abspath(os.path.join(_CSRC, "libhashbatch.so"))
 _SRC_PATH = os.path.abspath(os.path.join(_CSRC, "hash_batch.c"))
+_HDR_PATH = os.path.abspath(os.path.join(_CSRC, "hash_consts.h"))
+
+
+def _lib_path() -> str:
+    h = hashlib.sha256()
+    for p in (_SRC_PATH, _HDR_PATH):
+        with open(p, "rb") as f:
+            h.update(f.read())
+    return os.path.abspath(
+        os.path.join(_CSRC, f"libhashbatch-{h.hexdigest()[:12]}.so"))
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -30,13 +40,15 @@ _I64P = ctypes.POINTER(ctypes.c_int64)
 _I32P = ctypes.POINTER(ctypes.c_int32)
 
 
-def _build() -> bool:
+def _build(lib_path: str) -> bool:
+    tmp = lib_path + ".tmp"
     for flags in (["-fopenmp"], []):
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-x", "c", _SRC_PATH,
-               "-o", _LIB_PATH] + flags
+               "-o", tmp] + flags
         try:
             r = subprocess.run(cmd, capture_output=True, timeout=120)
             if r.returncode == 0:
+                os.replace(tmp, lib_path)  # atomic vs concurrent builders
                 return True
         except (OSError, subprocess.TimeoutExpired):
             return False
@@ -52,11 +64,10 @@ def _load() -> ctypes.CDLL | None:
         if os.environ.get("TM_TPU_DISABLE_CHASH") == "1":
             return None
         try:
-            stale = (not os.path.exists(_LIB_PATH)
-                     or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC_PATH))
-            if stale and not _build():
+            lib_path = _lib_path()
+            if not os.path.exists(lib_path) and not _build(lib_path):
                 return None
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = ctypes.CDLL(lib_path)
         except OSError:
             return None
         lib.sha512_batch.argtypes = [_U8P, _I64P, _I32P, ctypes.c_int64, _U8P]

@@ -69,3 +69,36 @@ def test_proof_rejects_wrong_leaf():
         assert False, "expected failure"
     except ValueError:
         pass
+
+
+def test_batched_matches_recursive():
+    # The level-order batched path (chash-backed) must produce the same root
+    # as the recursive reference shape for every size straddling the
+    # threshold, including odd/power-of-two/one-off sizes.
+    import tendermint_tpu.crypto.merkle as m
+
+    for n in list(range(1, 20)) + [63, 64, 65, 127, 128, 129, 1000]:
+        items = [b"item-%d" % i for i in range(n)]
+        batched = m._hash_from_byte_slices_batched(items)
+        recursive = _recursive_root(m, items)
+        assert batched == recursive, n
+
+
+def _recursive_root(m, items):
+    n = len(items)
+    if n == 1:
+        return m.leaf_hash(items[0])
+    k = m.split_point(n)
+    return m.inner_hash(_recursive_root(m, items[:k]), _recursive_root(m, items[k:]))
+
+
+def test_batched_without_c_lib(monkeypatch):
+    # hashlib fallback inside chash must give identical results.
+    from tendermint_tpu.ops import chash
+
+    monkeypatch.setattr(chash, "_lib", None)
+    monkeypatch.setattr(chash, "_tried", True)
+    import tendermint_tpu.crypto.merkle as m
+
+    items = [b"x%d" % i for i in range(100)]
+    assert m._hash_from_byte_slices_batched(items) == _recursive_root(m, items)
