@@ -1,0 +1,48 @@
+"""Light client (reference: light/).
+
+ - verifier: pure header verification (adjacent / non-adjacent / backwards)
+ - client: Client with sequential + skipping (bisection) modes, trust anchor
+   options, trusted-store persistence, witness cross-checking
+ - detector: divergence detection + LightClientAttackEvidence construction
+ - provider: Mock / local-node / JSON-RPC light-block providers
+ - store: DB-backed trusted store
+ - range_verify: whole-chain sequential verification in ONE BatchVerifier
+   flush (BASELINE config 3: 10k headers -> one TPU kernel launch)
+"""
+
+from tendermint_tpu.light.client import SEQUENTIAL, SKIPPING, Client, TrustOptions
+from tendermint_tpu.light.provider import (
+    HTTPProvider,
+    MockProvider,
+    NodeProvider,
+    Provider,
+)
+from tendermint_tpu.light.range_verify import verify_header_range
+from tendermint_tpu.light.store import DBStore
+from tendermint_tpu.light.verifier import (
+    DEFAULT_TRUST_LEVEL,
+    LightClientError,
+    verify,
+    verify_adjacent,
+    verify_backwards,
+    verify_non_adjacent,
+)
+
+__all__ = [
+    "Client",
+    "TrustOptions",
+    "SEQUENTIAL",
+    "SKIPPING",
+    "Provider",
+    "MockProvider",
+    "NodeProvider",
+    "HTTPProvider",
+    "DBStore",
+    "verify",
+    "verify_adjacent",
+    "verify_non_adjacent",
+    "verify_backwards",
+    "verify_header_range",
+    "DEFAULT_TRUST_LEVEL",
+    "LightClientError",
+]

@@ -1,0 +1,362 @@
+"""Light client (reference: light/client.go).
+
+Verifies headers from a primary provider against a trust anchor, using
+sequential or skipping (bisection) verification, cross-checks every newly
+verified header against witness providers (detector.py), and persists
+verified blocks in a trusted store.
+
+TPU angle: every commit check inside verify funnels through the batched
+BatchVerifier (types/validator_set.py), so one bisection step costs at most
+two kernel flushes; verify_header_range (range_verify.py) does whole-chain
+sequential verification in a single flush.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.light import verifier as lv
+from tendermint_tpu.light.detector import (
+    compare_first_header_with_witnesses,
+    detect_divergence,
+)
+from tendermint_tpu.light.provider import (
+    ErrLightBlockNotFound,
+    Provider,
+    ProviderError,
+)
+from tendermint_tpu.light.store import DBStore
+from tendermint_tpu.light.verifier import (
+    DEFAULT_TRUST_LEVEL,
+    ErrOldHeaderExpired,
+    LightClientError,
+    validate_trust_level,
+)
+from tendermint_tpu.types.light_block import LightBlock
+from tendermint_tpu.types.ttime import Time
+
+SEQUENTIAL = "sequential"
+SKIPPING = "skipping"
+
+DEFAULT_PRUNING_SIZE = 1000
+DEFAULT_MAX_CLOCK_DRIFT_S = 10.0
+DEFAULT_MAX_RETRY_ATTEMPTS = 10
+
+
+@dataclass
+class TrustOptions:
+    """Trust anchor (reference: light/client.go:58-84 TrustOptions)."""
+
+    period_s: float
+    height: int
+    hash: bytes
+
+    def validate_basic(self) -> None:
+        if self.period_s <= 0:
+            raise LightClientError("negative or zero trusting period")
+        if self.height <= 0:
+            raise LightClientError("negative or zero height")
+        if len(self.hash) != 32:
+            raise LightClientError(
+                f"expected hash size to be 32 bytes, got {len(self.hash)} bytes"
+            )
+
+
+from tendermint_tpu.light.detector import ErrNoWitnesses  # noqa: E402  (re-export)
+
+
+class Client:
+    """reference: light/client.go:174 (Client struct), :225 NewClient."""
+
+    def __init__(
+        self,
+        chain_id: str,
+        trust_options: TrustOptions,
+        primary: Provider,
+        witnesses: list[Provider],
+        trusted_store: DBStore,
+        *,
+        verification_mode: str = SKIPPING,
+        trust_level: tuple[int, int] = DEFAULT_TRUST_LEVEL,
+        max_clock_drift_s: float = DEFAULT_MAX_CLOCK_DRIFT_S,
+        pruning_size: int = DEFAULT_PRUNING_SIZE,
+        logger=None,
+    ):
+        if verification_mode not in (SEQUENTIAL, SKIPPING):
+            raise LightClientError(f"unknown verification mode {verification_mode}")
+        validate_trust_level(trust_level)
+        trust_options.validate_basic()
+        self.chain_id = chain_id
+        self.trusting_period_s = trust_options.period_s
+        self.verification_mode = verification_mode
+        self.trust_level = trust_level
+        self.max_clock_drift_s = max_clock_drift_s
+        self.primary = primary
+        self.witnesses = list(witnesses)
+        self.had_witnesses = bool(witnesses)
+        self.trusted_store = trusted_store
+        self.pruning_size = pruning_size
+        self.logger = logger
+        self.latest_trusted: LightBlock | None = trusted_store.latest_light_block()
+        if self.latest_trusted is None:
+            self._initialize(trust_options)
+        else:
+            self._check_trusted_header_using_options(trust_options)
+
+    # --- initialization (reference: light/client.go:352-431) ---------------
+
+    def _initialize(self, opts: TrustOptions) -> None:
+        lb = self._light_block_from_primary(opts.height)
+        # Ensure the header matches the trusted hash, then self-verify:
+        # 2/3 of the block's OWN validator set must have signed
+        # (reference: light/client.go:381-418).
+        if lb.hash() != opts.hash:
+            raise LightClientError(
+                f"expected header's hash {opts.hash.hex()}, but got {lb.hash().hex()}"
+            )
+        lb.validate_basic(self.chain_id)
+        lb.validator_set.verify_commit_light(
+            self.chain_id,
+            lb.signed_header.commit.block_id,
+            lb.height,
+            lb.signed_header.commit,
+        )
+        compare_first_header_with_witnesses(self, lb.signed_header)
+        self._update_trusted_light_block(lb)
+
+    def _check_trusted_header_using_options(self, opts: TrustOptions) -> None:
+        """Existing trusted state vs new options (reference:
+        light/client.go:272-350 checkTrustedHeaderUsingOptions)."""
+        primary_hash = None
+        if self.latest_trusted.height >= opts.height:
+            stored = self.trusted_store.light_block(opts.height)
+            if stored is not None:
+                primary_hash = stored.hash()
+        if primary_hash is None:
+            lb = self._light_block_from_primary(opts.height)
+            primary_hash = lb.hash()
+        if primary_hash != opts.hash:
+            # Trust anchor changed: wipe and restart from options.
+            self._cleanup()
+            self._initialize(opts)
+
+    # --- public API --------------------------------------------------------
+
+    def trusted_light_block(self, height: int) -> LightBlock:
+        """reference: light/client.go:1011 TrustedLightBlock."""
+        latest = self.latest_trusted
+        if latest is None:
+            raise LightClientError("no trusted state yet")
+        if height > latest.height:
+            raise LightClientError(
+                f"height requested is too high: {height} vs latest {latest.height}"
+            )
+        lb = self.trusted_store.light_block(height)
+        if lb is None:
+            raise LightClientError(f"no light block at height {height}")
+        return lb
+
+    def first_trusted_height(self) -> int:
+        return self.trusted_store.first_light_block_height()
+
+    def update(self, now: Time) -> LightBlock | None:
+        """Verify the latest header from primary if newer than latest trusted
+        (reference: light/client.go:443 Update)."""
+        latest_trusted = self.latest_trusted
+        if latest_trusted is None:
+            raise LightClientError("no trusted state yet")
+        latest = self._light_block_from_primary(0)
+        if latest.height > latest_trusted.height:
+            self.verify_light_block(latest, now)
+            return latest
+        return None
+
+    def verify_light_block_at_height(self, height: int, now: Time) -> LightBlock:
+        """reference: light/client.go:474 VerifyLightBlockAtHeight."""
+        if height <= 0:
+            raise LightClientError("negative or zero height")
+        lb = self.trusted_store.light_block(height)
+        if lb is not None:
+            return lb
+        lb = self._light_block_from_primary(height)
+        self.verify_light_block(lb, now)
+        return lb
+
+    def verify_light_block(self, new_lb: LightBlock, now: Time) -> None:
+        """reference: light/client.go:525 VerifyHeader (+ :558
+        verifyLightBlock)."""
+        h = self.trusted_store.light_block(new_lb.height)
+        if h is not None:
+            if h.hash() == new_lb.hash():
+                return
+            raise LightClientError(
+                f"existing trusted header {h.hash().hex()} does not match "
+                f"new header {new_lb.hash().hex()}"
+            )
+        new_lb.validate_basic(self.chain_id)
+
+        latest = self.latest_trusted
+        if latest is not None and new_lb.height < latest.height:
+            # Historical header: find closest trusted below, verify forward,
+            # or walk backwards from the first trusted block
+            # (reference: light/client.go:558-600 verifyLightBlock).
+            closest = self.trusted_store.light_block_before(new_lb.height)
+            if closest is not None:
+                self._verify_from(closest, new_lb, now)
+            else:
+                first = self.trusted_store.light_block(self.first_trusted_height())
+                self._backwards(first, new_lb)
+        else:
+            anchor = latest
+            if anchor is None:
+                raise LightClientError("no trusted state yet")
+            self._verify_from(anchor, new_lb, now)
+
+        detect_divergence(self, new_lb, now)
+        self._update_trusted_light_block(new_lb)
+
+    # --- verification strategies ------------------------------------------
+
+    def _verify_from(self, trusted: LightBlock, new_lb: LightBlock, now: Time) -> None:
+        if self.verification_mode == SEQUENTIAL:
+            self._verify_sequential(trusted, new_lb, now)
+        else:
+            self._verify_skipping_against_primary(trusted, new_lb, now)
+
+    def _verify_sequential(self, trusted: LightBlock, new_lb: LightBlock, now: Time) -> None:
+        """Verify every header in (trusted, new] (reference:
+        light/client.go:613 verifySequential)."""
+        verified = trusted
+        for height in range(trusted.height + 1, new_lb.height + 1):
+            inter = new_lb if height == new_lb.height else self._light_block_from_primary(height)
+            lv.verify_adjacent(
+                verified.signed_header,
+                inter.signed_header,
+                inter.validator_set,
+                self.trusting_period_s,
+                now,
+                self.max_clock_drift_s,
+            )
+            if height != new_lb.height:
+                self.trusted_store.save_light_block(inter)
+            verified = inter
+
+    def _verify_skipping_against_primary(
+        self, trusted: LightBlock, new_lb: LightBlock, now: Time
+    ) -> None:
+        self._verify_skipping(self.primary, trusted, new_lb, now)
+
+    def _verify_skipping(
+        self, source: Provider, trusted: LightBlock, new_lb: LightBlock, now: Time
+    ) -> list[LightBlock]:
+        """Bisection (reference: light/client.go:706 verifySkipping).
+
+        Maintains a stack of pending blocks; on ErrNewValSetCantBeTrusted,
+        fetch the midpoint and retry against it.
+        """
+        block_cache = [new_lb]
+        verified_blocks = []
+        depth = 0
+        verified = trusted
+        # Captured once: self.primary may be reassigned mid-bisection by a
+        # witness promotion inside _light_block_from_primary.
+        use_primary = source is self.primary
+        while True:
+            candidate = block_cache[depth]
+            try:
+                lv.verify(
+                    verified.signed_header,
+                    verified.validator_set,
+                    candidate.signed_header,
+                    candidate.validator_set,
+                    self.trusting_period_s,
+                    now,
+                    self.max_clock_drift_s,
+                    self.trust_level,
+                )
+            except lv.ErrNewValSetCantBeTrusted:
+                # Can't skip that far: bisect (reference client.go:755-776).
+                pivot = (verified.height + candidate.height) // 2
+                if pivot == verified.height:
+                    raise LightClientError(
+                        "bisection failed to converge "
+                        f"({verified.height} -> {candidate.height})"
+                    )
+                inter = (
+                    self._light_block_from_primary(pivot)
+                    if use_primary
+                    else source.light_block(pivot)
+                )
+                inter.validate_basic(self.chain_id)
+                block_cache.insert(depth + 1, inter)
+                depth += 1
+                continue
+            # Verified one step.
+            if candidate.height == new_lb.height:
+                return verified_blocks
+            verified = candidate
+            verified_blocks.append(candidate)
+            if candidate.height != new_lb.height:
+                self.trusted_store.save_light_block(candidate)
+            depth = 0
+            block_cache = [b for b in block_cache if b.height > candidate.height]
+            if not block_cache:
+                block_cache = [new_lb]
+
+    def _backwards(self, trusted: LightBlock, new_lb: LightBlock) -> None:
+        """Hash-linked walk below the first trusted header (reference:
+        light/client.go:942 backwards)."""
+        verified = trusted.signed_header.header
+        for height in range(trusted.height - 1, new_lb.height - 1, -1):
+            inter = (
+                new_lb
+                if height == new_lb.height
+                else self._light_block_from_primary(height)
+            )
+            lv.verify_backwards(inter.signed_header.header, verified)
+            verified = inter.signed_header.header
+
+    # --- maintenance -------------------------------------------------------
+
+    def _update_trusted_light_block(self, lb: LightBlock) -> None:
+        self.trusted_store.save_light_block(lb)
+        if self.pruning_size > 0:
+            self.trusted_store.prune(self.pruning_size)
+        if self.latest_trusted is None or lb.height > self.latest_trusted.height:
+            self.latest_trusted = lb
+
+    def _cleanup(self) -> None:
+        """Remove all trusted state (reference: light/client.go:1041)."""
+        hs = []
+        h = self.trusted_store.first_light_block_height()
+        latest = self.trusted_store.latest_light_block()
+        if h > 0 and latest is not None:
+            hs = range(h, latest.height + 1)
+        for height in hs:
+            self.trusted_store.delete_light_block(height)
+        self.latest_trusted = None
+
+    def _light_block_from_primary(self, height: int) -> LightBlock:
+        """Fetch from primary; on failure, promote a witness (reference:
+        light/client.go:1080 lightBlockFromPrimary + replacePrimaryProvider)."""
+        try:
+            lb = self.primary.light_block(height)
+            lb.validate_basic(self.chain_id)
+            return lb
+        except (ProviderError, ValueError) as primary_err:
+            if isinstance(primary_err, ErrLightBlockNotFound):
+                raise
+            # Replace primary with the first responsive witness.
+            for i, w in enumerate(self.witnesses):
+                try:
+                    lb = w.light_block(height)
+                    lb.validate_basic(self.chain_id)
+                except (ProviderError, ValueError):
+                    continue
+                self.primary = w
+                self.witnesses = self.witnesses[:i] + self.witnesses[i + 1:]
+                return lb
+            raise
+
+    def remove_witness(self, idx: int) -> None:
+        self.witnesses.pop(idx)

@@ -1,0 +1,158 @@
+"""Witness cross-checking / attack detection (reference: light/detector.go).
+
+After the primary's header is verified, every witness is asked for its block
+at the same height. A hash mismatch means either the primary or the witness is
+lying; the divergent trace is examined, LightClientAttackEvidence is built and
+reported to BOTH providers (the honest one forwards it to the chain for
+slashing), and the lying witness is dropped.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.light.provider import (
+    ErrHeightTooHigh,
+    ErrLightBlockNotFound,
+    ProviderError,
+)
+from tendermint_tpu.types.evidence import LightClientAttackEvidence
+from tendermint_tpu.types.light_block import LightBlock, SignedHeader
+from tendermint_tpu.types.ttime import Time
+
+
+class ErrNoWitnesses(Exception):
+    """All witnesses are dead or removed — cross-checking is impossible
+    (reference: light/errors.go:66)."""
+
+
+class ErrConflictingHeaders(Exception):
+    """A witness reported a different header (reference: light/errors.go:88)."""
+
+    def __init__(self, block: LightBlock, witness_index: int):
+        self.block = block
+        self.witness_index = witness_index
+        super().__init__(
+            f"header hash ({block.hash().hex()}) from witness {witness_index} "
+            "does not match primary"
+        )
+
+
+@dataclass
+class Divergence:
+    """One detected attack (divergent witness + evidence built against the
+    provider whose chain is wrong)."""
+
+    witness_index: int
+    evidence_against_primary: LightClientAttackEvidence | None
+    evidence_against_witness: LightClientAttackEvidence | None
+
+
+def compare_first_header_with_witnesses(client, sh: SignedHeader) -> None:
+    """At initialization the trust-anchor header must match on every witness
+    (reference: light/detector.go:376 compareFirstHeaderWithWitnesses)."""
+    if not client.witnesses:
+        return
+    bad = []
+    for i, w in enumerate(client.witnesses):
+        try:
+            lb = w.light_block(sh.height)
+        except (ErrHeightTooHigh, ErrLightBlockNotFound, ProviderError):
+            continue
+        if lb.hash() != sh.hash():
+            raise ErrConflictingHeaders(lb, i)
+        if w.chain_id() != client.chain_id:
+            bad.append(i)
+    for i in reversed(bad):
+        client.remove_witness(i)
+
+
+def detect_divergence(client, new_lb: LightBlock, now: Time) -> None:
+    """Cross-examine the freshly verified block (reference:
+    light/detector.go:48 detectDivergence).
+
+    A client configured WITH witnesses must never silently continue once all
+    of them are dead/removed (reference returns ErrNoWitnesses); a client
+    explicitly configured with zero witnesses skips detection."""
+    if not client.witnesses:
+        if getattr(client, "had_witnesses", False):
+            raise ErrNoWitnesses("no witnesses connected. falling back to primary alone")
+        return
+    sh = new_lb.signed_header
+    conflicts: list[ErrConflictingHeaders] = []
+    dead: list[int] = []
+    for i, w in enumerate(client.witnesses):
+        try:
+            lb = w.light_block(sh.height)
+        except ErrHeightTooHigh:
+            continue  # witness hasn't caught up yet — not evidence of lying
+        except (ErrLightBlockNotFound, ProviderError):
+            dead.append(i)
+            continue
+        if lb.hash() != sh.hash():
+            conflicts.append(ErrConflictingHeaders(lb, i))
+
+    for c in conflicts:
+        _handle_conflicting_headers(client, c, new_lb, now)
+    for i in reversed(sorted(set(dead + [c.witness_index for c in conflicts]))):
+        if i < len(client.witnesses):
+            client.remove_witness(i)
+    if conflicts:
+        # The reference errors out so the caller re-examines trust
+        # (light/detector.go:95-113); surface the first conflict.
+        raise conflicts[0]
+
+
+def _handle_conflicting_headers(client, conflict: ErrConflictingHeaders,
+                                primary_block: LightBlock, now: Time) -> None:
+    """Build and report evidence for one divergence (reference:
+    light/detector.go:116 compareNewHeaderWithWitness +
+    examineConflictingHeaderAgainstTrace)."""
+    witness = client.witnesses[conflict.witness_index]
+    common = client.latest_trusted
+    if common is None or common.height >= primary_block.height:
+        common = client.trusted_store.light_block_before(primary_block.height)
+    if common is None:
+        return
+
+    witness_block = conflict.block
+    # Evidence against whichever chain diverges from the common ancestor:
+    # report both directions; honest full nodes discard the invalid one
+    # (reference: light/detector.go:135-176 gatherEvidence).
+    ev_against_witness = make_attack_evidence(common, witness_block)
+    ev_against_primary = make_attack_evidence(common, primary_block)
+    for ev, target in ((ev_against_witness, client.primary),
+                       (ev_against_primary, witness)):
+        if ev is None:
+            continue
+        try:
+            target.report_evidence(ev)
+        except ProviderError:
+            pass
+
+
+def make_attack_evidence(common: LightBlock,
+                         conflicted: LightBlock) -> LightClientAttackEvidence | None:
+    """reference: light/detector.go:271 newLightClientAttackEvidence.
+
+    byzantine validator extraction happens server-side in the evidence pool
+    (evidence/verify.go GetByzantineValidators); the light client ships the
+    conflicting block + common height.
+    """
+    if conflicted is None:
+        return None
+    return LightClientAttackEvidence(
+        conflicting_block=conflicted,
+        common_height=common.height,
+        total_voting_power=common.validator_set.total_voting_power(),
+        timestamp=common.signed_header.header.time,
+    )
+
+
+__all__ = [
+    "ErrConflictingHeaders",
+    "Divergence",
+    "compare_first_header_with_witnesses",
+    "detect_divergence",
+    "make_attack_evidence",
+]

@@ -1,0 +1,118 @@
+"""Batched sequential header-range verification — BASELINE config 3.
+
+The reference light client verifies a header chain one header at a time, each
+`VerifyAdjacent` paying a serial loop of ed25519 verifies
+(light/verifier.go:93 -> types/validator_set.go:719). On TPU that is the wrong
+shape: a 10k-header catch-up is ~10k * 2/3|V| signatures that are all known up
+front.
+
+`verify_header_range` does the cheap hash-linkage checks serially on host
+(NextValidatorsHash chaining, time monotonicity, validator-hash match), queues
+every commit's serial-semantics signature prefix into ONE BatchVerifier flush
+(one wide TPU kernel launch), then replays each header's serial accept/reject
+decision over the returned bitmap. The overall accept/reject matches running
+verify_adjacent per header; the one reporting difference is that a structural
+defect anywhere in the range is detected in the host pass and therefore
+reported before a bad SIGNATURE at an earlier height (a sequential loop would
+hit the earlier signature first). Chains that a sequential loop accepts are
+accepted with identical side effects.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.crypto import batch as crypto_batch
+from tendermint_tpu.light import verifier as lv
+from tendermint_tpu.types.light_block import LightBlock
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.validator_set import (
+    ErrNotEnoughVotingPowerSigned,
+    ErrWrongSignature,
+)
+
+
+class RangeVerifyError(lv.LightClientError):
+    def __init__(self, height: int, reason: Exception | str):
+        self.height = height
+        self.reason = reason
+        super().__init__(f"header range verification failed at height {height}: {reason}")
+
+
+def verify_header_range(trusted: LightBlock, chain: list[LightBlock],
+                        trusting_period_s: float, now: Time,
+                        max_clock_drift_s: float = 10.0,
+                        store=None) -> None:
+    """Verify `chain` (ascending, adjacent heights) against `trusted`.
+
+    Raises RangeVerifyError naming the failing height (see module docstring
+    for the error-ordering caveat vs a sequential loop). When `store` is
+    given, every verified block is saved into it.
+    """
+    if not chain:
+        return
+    # Phase 1: host-side structural checks + signature collection.
+    verifier = crypto_batch.create_batch_verifier()
+    plan = []  # (lb, prefix, needed)
+    prev = trusted
+    for lb in chain:
+        sh, vals = lb.signed_header, lb.validator_set
+        if sh.height != prev.height + 1:
+            raise RangeVerifyError(sh.height, "headers must be adjacent in height")
+        if lv.header_expired(prev.signed_header, trusting_period_s, now):
+            raise RangeVerifyError(
+                sh.height, lv.ErrOldHeaderExpired(
+                    Time.from_unix_ns(prev.signed_header.header.time.unix_ns()
+                                      + int(trusting_period_s * 1e9)), now))
+        try:
+            lv._verify_new_header_and_vals(
+                sh, vals, prev.signed_header, now, max_clock_drift_s)
+        except lv.LightClientError as e:
+            raise RangeVerifyError(sh.height, e) from e
+        if sh.header.validators_hash != prev.signed_header.header.next_validators_hash:
+            raise RangeVerifyError(
+                sh.height,
+                f"expected old header next validators "
+                f"({prev.signed_header.header.next_validators_hash.hex()}) to match "
+                f"those from new header ({sh.header.validators_hash.hex()})"
+            )
+        # commit.height == sh.height and commit.block_id == header hash were
+        # already enforced by sh.validate_basic inside
+        # _verify_new_header_and_vals; only the set-size check remains.
+        commit = sh.commit
+        if vals.size() != len(commit.signatures):
+            raise RangeVerifyError(
+                sh.height, f"wrong set size: {vals.size()} vs {len(commit.signatures)}")
+        needed = vals.total_voting_power() * 2 // 3
+        prefix = vals.commit_light_prefix(commit, needed)
+        chain_id = sh.header.chain_id
+        for idx in prefix:
+            verifier.add(
+                vals.validators[idx].pub_key,
+                commit.vote_sign_bytes(chain_id, idx),
+                commit.signatures[idx].signature,
+            )
+        plan.append((lb, prefix, needed))
+        prev = lb
+
+    # Phase 2: ONE flush for the whole range.
+    _, bitmap = verifier.verify()
+
+    # Phase 3: replay each header's serial decision over its bitmap slice.
+    pos = 0
+    for lb, prefix, needed in plan:
+        vals, commit = lb.validator_set, lb.signed_header.commit
+        tallied = 0
+        ok_height = False
+        for idx, ok in zip(prefix, bitmap[pos:pos + len(prefix)]):
+            if not ok:
+                raise RangeVerifyError(
+                    lb.height, ErrWrongSignature(idx, commit.signatures[idx].signature))
+            tallied += vals.validators[idx].voting_power
+            if tallied > needed:
+                ok_height = True
+                break
+        pos += len(prefix)
+        if not ok_height:
+            raise RangeVerifyError(
+                lb.height, ErrNotEnoughVotingPowerSigned(tallied, needed))
+        if store is not None:
+            store.save_light_block(lb)

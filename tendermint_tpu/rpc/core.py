@@ -444,8 +444,41 @@ def abci_info(env):
     }}
 
 
+def light_block(env, height=0):
+    """Hex-marshaled LightBlock for light clients / state sync.
+
+    Not a reference route (the Go light provider assembles a LightBlock from
+    /commit + paginated /validators, light/provider/http/http.go:65); one
+    binary round-trip replaces 1+N/100 JSON ones. Error messages are part of
+    the wire contract: HTTPProvider classifies 'must be less' as
+    height-too-high and 'could not find' as not-found."""
+    from tendermint_tpu.light.provider import (
+        ErrHeightTooHigh,
+        ErrLightBlockNotFound,
+        NodeProvider,
+    )
+
+    h = int(height)
+    provider = NodeProvider(env.node.genesis.chain_id, env.node.block_store,
+                            env.node.state_store)
+    try:
+        lb = provider.light_block(h)
+    except ErrHeightTooHigh as e:
+        raise ValueError(
+            f"height {h} must be less than or equal to the current blockchain height"
+        ) from e
+    except ErrLightBlockNotFound as e:
+        raise ValueError(f"could not find block: {e}") from e
+    return {"height": str(lb.height), "light_block": lb.marshal().hex()}
+
+
 def broadcast_evidence(env, evidence):
-    raise ValueError("evidence must be submitted via p2p in this build")
+    """reference: rpc/core/evidence.go:17 BroadcastEvidence."""
+    from tendermint_tpu.types.evidence import evidence_unmarshal
+
+    ev = evidence_unmarshal(bytes.fromhex(evidence))
+    env.node.evidence_pool.add_evidence(ev)
+    return {"hash": ev.hash().hex()}
 
 
 ROUTES = {
@@ -459,6 +492,7 @@ ROUTES = {
     "block_by_hash": block_by_hash,
     "block_results": block_results,
     "commit": commit,
+    "light_block": light_block,
     "validators": validators,
     "consensus_params": consensus_params,
     "consensus_state": consensus_state,

@@ -83,6 +83,9 @@ class LightBlock:
     def height(self) -> int:
         return self.signed_header.height if self.signed_header else 0
 
+    def hash(self) -> bytes | None:
+        return self.signed_header.hash() if self.signed_header else None
+
     def marshal(self) -> bytes:
         w = proto.Writer()
         if self.signed_header is not None:

@@ -322,6 +322,23 @@ class ValidatorSet:
         if tallied <= needed:
             raise ErrNotEnoughVotingPowerSigned(tallied, needed)
 
+    def commit_light_prefix(self, commit, needed: int) -> list[int]:
+        """Indexes the serial VerifyCommitLight would actually verify: the
+        shortest for_block prefix whose power exceeds `needed` (the reference
+        stopping rule, types/validator_set.go:740-762). Shared by
+        verify_commit_light and light.range_verify so the serial-semantics
+        replay can never drift between them."""
+        prefix: list[int] = []
+        tallied = 0
+        for idx, cs in enumerate(commit.signatures):
+            if not cs.for_block():
+                continue
+            prefix.append(idx)
+            tallied += self.validators[idx].voting_power
+            if tallied > needed:
+                break
+        return prefix
+
     def verify_commit_light(self, chain_id: str, block_id: BlockID, height: int, commit) -> None:
         """Stops at +2/3 like the serial code: signatures past the serial
         stopping point are not consulted (reference:
@@ -335,19 +352,7 @@ class ValidatorSet:
                 f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
             )
         needed = self.total_voting_power() * 2 // 3
-
-        # Serial semantics: only indexes up to the threshold-crossing one are
-        # ever verified. Pre-compute that prefix, batch only it.
-        prefix: list[int] = []
-        tallied_scan = 0
-        for idx, cs in enumerate(commit.signatures):
-            if not cs.for_block():
-                continue
-            prefix.append(idx)
-            tallied_scan += self.validators[idx].voting_power
-            if tallied_scan > needed:
-                break
-
+        prefix = self.commit_light_prefix(commit, needed)
         verifier = crypto_batch.create_batch_verifier()
         for idx in prefix:
             verifier.add(

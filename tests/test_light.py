@@ -1,0 +1,303 @@
+"""Light client: verifier semantics (port of light/verifier_test.go cases),
+client sequential/skipping verification, witness detector, trusted store,
+and the batched header-range verify (BASELINE config 3)."""
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.light import verifier as lv
+from tendermint_tpu.light.client import Client, TrustOptions, SEQUENTIAL, SKIPPING
+from tendermint_tpu.light.detector import ErrConflictingHeaders
+from tendermint_tpu.light.provider import (
+    ErrHeightTooHigh,
+    ErrLightBlockNotFound,
+    MockProvider,
+)
+from tendermint_tpu.light.range_verify import RangeVerifyError, verify_header_range
+from tendermint_tpu.light.store import DBStore
+from tendermint_tpu.store.db import MemDB
+from tendermint_tpu.types.block import Commit, CommitSig, Header
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.light_block import LightBlock, SignedHeader
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import BLOCK_ID_FLAG_COMMIT, PRECOMMIT_TYPE, Vote
+
+CHAIN_ID = "light-test-chain"
+TRUST_PERIOD = 3 * 3600.0
+DRIFT = 10.0
+T0 = 1_700_000_000
+
+
+def t(sec):
+    return Time(T0 + sec, 0)
+
+
+def _mk_keys(n, power=10, seed=0):
+    pairs = []
+    for i in range(n):
+        priv = ed25519.gen_priv_key(bytes([(seed * 37 + i + 1) % 256]) * 32)
+        pairs.append((priv, Validator.new(priv.pub_key(), power)))
+    vs = ValidatorSet([v for _, v in pairs])
+    by_addr = {v.address: p for p, v in pairs}
+    privs = [by_addr[v.address] for v in vs.validators]
+    return privs, vs
+
+
+def _sign_commit(header, vals, privs, *, skip=(), bad_sig=()):
+    bid = BlockID(hash=header.hash(),
+                  part_set_header=PartSetHeader(total=1, hash=b"\xcd" * 32))
+    sigs = []
+    for i, (priv, val) in enumerate(zip(privs, vals.validators)):
+        if i in skip:
+            sigs.append(CommitSig.new_absent())
+            continue
+        ts = Time(header.time.seconds, 0)
+        vote = Vote(type=PRECOMMIT_TYPE, height=header.height, round=1,
+                    block_id=bid, timestamp=ts,
+                    validator_address=val.address, validator_index=i)
+        sig = priv.sign(vote.sign_bytes(CHAIN_ID))
+        if i in bad_sig:
+            sig = sig[:-1] + bytes([sig[-1] ^ 1])
+        sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, val.address, ts, sig))
+    return Commit(height=header.height, round=1, block_id=bid, signatures=sigs)
+
+
+def _mk_header(height, time_s, vals, next_vals, last_bid=None):
+    return Header(
+        chain_id=CHAIN_ID, height=height, time=t(time_s),
+        last_block_id=last_bid or BlockID(),
+        validators_hash=vals.hash(), next_validators_hash=next_vals.hash(),
+        proposer_address=vals.validators[0].address,
+    )
+
+
+def gen_chain(n, privs, vs, start_time=0, step_s=10):
+    """n adjacent light blocks (heights 1..n) under one validator set."""
+    out = []
+    last_bid = BlockID()
+    for h in range(1, n + 1):
+        header = _mk_header(h, start_time + h * step_s, vs, vs, last_bid)
+        commit = _sign_commit(header, vs, privs)
+        out.append(LightBlock(signed_header=SignedHeader(header, commit),
+                              validator_set=vs.copy()))
+        last_bid = commit.block_id
+    return out
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return _mk_keys(4)
+
+
+@pytest.fixture(scope="module")
+def chain(keys):
+    privs, vs = keys
+    return gen_chain(12, privs, vs)
+
+
+# --- verifier (reference: light/verifier_test.go) --------------------------
+
+def test_verify_adjacent_happy(chain):
+    lv.verify_adjacent(chain[0].signed_header, chain[1].signed_header,
+                       chain[1].validator_set, TRUST_PERIOD, t(100), DRIFT)
+
+
+def test_verify_adjacent_expired_trusted(chain):
+    with pytest.raises(lv.ErrOldHeaderExpired):
+        lv.verify_adjacent(chain[0].signed_header, chain[1].signed_header,
+                           chain[1].validator_set, 1.0, t(1000), DRIFT)
+
+
+def test_verify_adjacent_future_time(chain):
+    # New header time is beyond now + drift.
+    with pytest.raises(lv.ErrInvalidHeader):
+        lv.verify_adjacent(chain[0].signed_header, chain[1].signed_header,
+                           chain[1].validator_set, TRUST_PERIOD, t(5), DRIFT)
+
+
+def test_verify_adjacent_vals_hash_mismatch(chain, keys):
+    privs, vs = keys
+    other_privs, other_vs = _mk_keys(4, seed=9)
+    header = _mk_header(2, 20, other_vs, other_vs)
+    commit = _sign_commit(header, other_vs, other_privs)
+    sh = SignedHeader(header, commit)
+    with pytest.raises(lv.LightClientError):
+        lv.verify_adjacent(chain[0].signed_header, sh, other_vs,
+                           TRUST_PERIOD, t(100), DRIFT)
+
+
+def test_verify_adjacent_insufficient_power(keys):
+    privs, vs = keys
+    c = gen_chain(2, privs, vs)
+    # Re-sign height 2's commit with 3 of 4 absent: 10 of 40 power < 2/3.
+    header = c[1].signed_header.header
+    commit = _sign_commit(header, vs, privs, skip=(1, 2, 3))
+    sh = SignedHeader(header, commit)
+    with pytest.raises(lv.ErrInvalidHeader):
+        lv.verify_adjacent(c[0].signed_header, sh, vs, TRUST_PERIOD, t(100), DRIFT)
+
+
+def test_verify_non_adjacent_happy(chain):
+    # Skip straight from height 1 to height 8; same valset so 1/3 trust holds.
+    lv.verify_non_adjacent(chain[0].signed_header, chain[0].validator_set,
+                           chain[7].signed_header, chain[7].validator_set,
+                           TRUST_PERIOD, t(200), DRIFT)
+
+
+def test_verify_non_adjacent_untrusted_valset():
+    privs, vs = _mk_keys(4)
+    c = gen_chain(1, privs, vs)
+    # Entirely new validator set at height 5: 0 of trusted power signed.
+    new_privs, new_vs = _mk_keys(4, seed=5)
+    header = _mk_header(5, 50, new_vs, new_vs)
+    commit = _sign_commit(header, new_vs, new_privs)
+    sh = SignedHeader(header, commit)
+    with pytest.raises(lv.ErrNewValSetCantBeTrusted):
+        lv.verify_non_adjacent(c[0].signed_header, c[0].validator_set,
+                               sh, new_vs, TRUST_PERIOD, t(200), DRIFT)
+
+
+def test_validate_trust_level():
+    for num, den in ((1, 3), (1, 2), (2, 3), (1, 1)):
+        lv.validate_trust_level((num, den))
+    for num, den in ((0, 1), (1, 4), (2, 1), (1, 0)):
+        with pytest.raises(lv.LightClientError):
+            lv.validate_trust_level((num, den))
+
+
+def test_verify_backwards(chain):
+    lv.verify_backwards(chain[1].signed_header.header,
+                        chain[2].signed_header.header)
+    # Wrong linkage: height 1 is not the parent of height 3.
+    with pytest.raises(lv.ErrInvalidHeader):
+        lv.verify_backwards(chain[0].signed_header.header,
+                            chain[2].signed_header.header)
+
+
+# --- trusted store ---------------------------------------------------------
+
+def test_store_roundtrip_and_prune(chain):
+    store = DBStore(MemDB())
+    for lb in chain[:5]:
+        store.save_light_block(lb)
+    assert store.size() == 5
+    assert store.latest_light_block().height == 5
+    assert store.first_light_block_height() == 1
+    assert store.light_block_before(4).height == 3
+    store.prune(2)
+    assert store.size() == 2
+    assert store.first_light_block_height() == 4
+    got = store.light_block(5)
+    assert got.signed_header.header.hash() == chain[4].hash()
+
+
+# --- client ----------------------------------------------------------------
+
+def _client(chain, mode, witnesses=(), store=None, height=1):
+    primary = MockProvider(CHAIN_ID, {lb.height: lb for lb in chain})
+    return Client(
+        CHAIN_ID,
+        TrustOptions(period_s=TRUST_PERIOD, height=height,
+                     hash=chain[height - 1].hash()),
+        primary, list(witnesses), store or DBStore(MemDB()),
+        verification_mode=mode,
+    ), primary
+
+
+def test_client_sequential_catchup(chain):
+    client, _ = _client(chain, SEQUENTIAL)
+    lb = client.verify_light_block_at_height(10, t(500))
+    assert lb.height == 10
+    # All intermediate headers were persisted.
+    assert client.trusted_store.light_block(5) is not None
+    assert client.latest_trusted.height == 10
+
+
+def test_client_skipping_catchup(chain):
+    client, _ = _client(chain, SKIPPING)
+    lb = client.verify_light_block_at_height(12, t(500))
+    assert lb.height == 12
+    assert client.latest_trusted.height == 12
+
+
+def test_client_update(chain):
+    client, _ = _client(chain, SKIPPING)
+    lb = client.update(t(500))
+    assert lb is not None and lb.height == 12
+    assert client.update(t(501)) is None  # already at tip
+
+
+def test_client_historical_and_backwards(chain):
+    client, _ = _client(chain, SEQUENTIAL, height=5)
+    client.verify_light_block_at_height(9, t(500))
+    # Height 3 < first trusted (5): backwards hash-linked walk.
+    lb = client.verify_light_block_at_height(3, t(500))
+    assert lb.height == 3
+
+
+def test_client_trust_anchor_mismatch(chain):
+    primary = MockProvider(CHAIN_ID, {lb.height: lb for lb in chain})
+    with pytest.raises(lv.LightClientError):
+        Client(CHAIN_ID,
+               TrustOptions(period_s=TRUST_PERIOD, height=1, hash=b"\x11" * 32),
+               primary, [], DBStore(MemDB()))
+
+
+def test_client_detector_conflicting_witness(chain, keys):
+    privs, vs = keys
+    # A forked chain: same heights, different app state (different time step).
+    fork = gen_chain(12, privs, vs, start_time=1, step_s=10)
+    assert fork[5].hash() != chain[5].hash()
+    # Witness agrees on the trust anchor (height 1) but forks afterwards.
+    witness_blocks = {lb.height: lb for lb in fork}
+    witness_blocks[1] = chain[0]
+    witness = MockProvider(CHAIN_ID, witness_blocks)
+    client, primary = _client(chain, SEQUENTIAL, witnesses=[witness])
+    with pytest.raises(ErrConflictingHeaders):
+        client.verify_light_block_at_height(6, t(500))
+    # Evidence was reported to both sides and the witness was dropped.
+    assert witness.evidences and primary.evidences
+    assert client.witnesses == []
+    # A client that HAD witnesses refuses to continue without any.
+    from tendermint_tpu.light.detector import ErrNoWitnesses
+    with pytest.raises(ErrNoWitnesses):
+        client.verify_light_block_at_height(8, t(500))
+
+
+def test_mock_provider_errors(chain):
+    p = MockProvider(CHAIN_ID, {lb.height: lb for lb in chain[:3]})
+    with pytest.raises(ErrHeightTooHigh):
+        p.light_block(99)
+    p.remove(2)
+    with pytest.raises(ErrLightBlockNotFound):
+        p.light_block(2)
+
+
+# --- batched range verify (BASELINE config 3 shape) ------------------------
+
+def test_range_verify_happy(keys):
+    privs, vs = keys
+    c = gen_chain(60, privs, vs)
+    store = DBStore(MemDB())
+    verify_header_range(c[0], c[1:], TRUST_PERIOD, t(900), DRIFT, store=store)
+    assert store.size() == 59
+
+
+def test_range_verify_matches_sequential_failure(keys):
+    privs, vs = keys
+    c = gen_chain(20, privs, vs)
+    # Corrupt one signature inside the serial 2/3 prefix at height 9.
+    bad_header = c[8].signed_header.header
+    c[8].signed_header.commit = _sign_commit(bad_header, vs, privs, bad_sig=(0,))
+    with pytest.raises(RangeVerifyError) as ei:
+        verify_header_range(c[0], c[1:], TRUST_PERIOD, t(900), DRIFT)
+    assert ei.value.height == 9
+
+
+def test_range_verify_broken_linkage(keys):
+    privs, vs = keys
+    c = gen_chain(5, privs, vs)
+    with pytest.raises(RangeVerifyError):
+        verify_header_range(c[0], [c[1], c[3]], TRUST_PERIOD, t(900), DRIFT)

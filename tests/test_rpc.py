@@ -95,6 +95,25 @@ def test_rpc_surface(tmp_path):
         assert "round_state" in cs
         ni = _rpc(base, "net_info")
         assert ni["n_peers"] == "0"
+
+        # light_block route + HTTPProvider wire round-trip
+        from tendermint_tpu.light.provider import (
+            ErrHeightTooHigh,
+            HTTPProvider,
+        )
+
+        lb_res = _rpc(base, "light_block", {"height": 1})
+        provider = HTTPProvider("rpc-chain", base)
+        lb = provider.light_block(1)
+        assert lb.height == 1 and lb.marshal().hex() == lb_res["light_block"]
+        lb.validate_basic("rpc-chain")
+        latest = provider.light_block(0)
+        assert latest.height >= 1
+        try:
+            provider.light_block(10_000)
+            raise AssertionError("expected ErrHeightTooHigh")
+        except ErrHeightTooHigh:
+            pass
     finally:
         node.stop()
 
