@@ -18,14 +18,24 @@ from tendermint_tpu.store.db import DB, MemDB
 VALIDATOR_TX_PREFIX = b"val:"
 
 
+SNAPSHOT_FORMAT = 1
+SNAPSHOT_CHUNK_SIZE = 16 * 1024
+RETAIN_SNAPSHOTS = 4
+
+
 class KVStoreApplication(abci.Application):
-    def __init__(self, db: DB | None = None):
+    def __init__(self, db: DB | None = None, snapshot_interval: int = 0):
         self.db = db if db is not None else MemDB()
         self.size = 0
         self.height = 0
         self.app_hash = b""
         self.val_updates: list[abci.ValidatorUpdate] = []
         self.validators: dict[bytes, int] = {}  # pubkey bytes -> power
+        # snapshot support (reference: the e2e app, test/e2e/app/app.go;
+        # the reference kvstore itself has none)
+        self.snapshot_interval = snapshot_interval
+        self._snapshots: list[tuple[abci.Snapshot, list[bytes]]] = []
+        self._restore: tuple[abci.Snapshot, list[bytes]] | None = None
         self._load_state()
 
     # --- state persistence -------------------------------------------------
@@ -92,6 +102,8 @@ class KVStoreApplication(abci.Application):
         self.app_hash = struct.pack(">Q", self.size)
         self.height += 1
         self._save_state()
+        if self.snapshot_interval and self.height % self.snapshot_interval == 0:
+            self._take_snapshot()
         return abci.ResponseCommit(data=self.app_hash)
 
     def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
@@ -102,6 +114,104 @@ class KVStoreApplication(abci.Application):
         if v is None:
             return abci.ResponseQuery(code=0, key=req.data, log="does not exist")
         return abci.ResponseQuery(code=0, key=req.data, value=v, log="exists")
+
+    # --- snapshots (serving + restore) --------------------------------------
+
+    def _serialize_state(self) -> bytes:
+        """Full app state as one blob: size/height/app_hash, validators,
+        kv pairs (length-prefixed, deterministic key order)."""
+        out = [struct.pack(">QQB", self.size, self.height, len(self.app_hash)),
+               self.app_hash]
+        vals = sorted(self.validators.items())
+        out.append(struct.pack(">I", len(vals)))
+        for pk, power in vals:
+            out.append(struct.pack(">Hq", len(pk), power) + pk)
+        kvs = list(self.db.iterator(b"kv:", b"kv;"))
+        out.append(struct.pack(">I", len(kvs)))
+        for k, v in kvs:
+            out.append(struct.pack(">II", len(k), len(v)) + k + v)
+        return b"".join(out)
+
+    def _deserialize_state(self, blob: bytes) -> None:
+        off = 17
+        size, height, hlen = struct.unpack(">QQB", blob[:off])
+        app_hash = blob[off:off + hlen]; off += hlen
+        (nvals,) = struct.unpack(">I", blob[off:off + 4]); off += 4
+        validators = {}
+        for _ in range(nvals):
+            plen, power = struct.unpack(">Hq", blob[off:off + 10]); off += 10
+            validators[blob[off:off + plen]] = power; off += plen
+        (nkv,) = struct.unpack(">I", blob[off:off + 4]); off += 4
+        pairs = []
+        for _ in range(nkv):
+            klen, vlen = struct.unpack(">II", blob[off:off + 8]); off += 8
+            k = blob[off:off + klen]; off += klen
+            pairs.append((k, blob[off:off + vlen])); off += vlen
+        # install atomically only after a full parse
+        self.size, self.height, self.app_hash = size, height, app_hash
+        self.validators = validators
+        for k, v in pairs:
+            self.db.set(k, v)
+        self._save_state()
+
+    def _take_snapshot(self) -> None:
+        import hashlib
+
+        blob = self._serialize_state()
+        chunks = [blob[i:i + SNAPSHOT_CHUNK_SIZE]
+                  for i in range(0, len(blob), SNAPSHOT_CHUNK_SIZE)] or [b""]
+        snap = abci.Snapshot(height=self.height, format=SNAPSHOT_FORMAT,
+                             chunks=len(chunks),
+                             hash=hashlib.sha256(blob).digest())
+        self._snapshots.append((snap, chunks))
+        self._snapshots = self._snapshots[-RETAIN_SNAPSHOTS:]
+
+    def list_snapshots(self, req: abci.RequestListSnapshots) -> abci.ResponseListSnapshots:
+        return abci.ResponseListSnapshots(snapshots=[s for s, _ in self._snapshots])
+
+    def load_snapshot_chunk(self, req: abci.RequestLoadSnapshotChunk) -> abci.ResponseLoadSnapshotChunk:
+        for s, chunks in self._snapshots:
+            if (s.height == req.height and s.format == req.format
+                    and 0 <= req.chunk < len(chunks)):
+                return abci.ResponseLoadSnapshotChunk(chunk=chunks[req.chunk])
+        return abci.ResponseLoadSnapshotChunk()
+
+    def offer_snapshot(self, req: abci.RequestOfferSnapshot) -> abci.ResponseOfferSnapshot:
+        s = req.snapshot
+        if s is None or s.format != SNAPSHOT_FORMAT:
+            return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_REJECT_FORMAT)
+        if s.chunks <= 0 or len(s.hash) != 32:
+            return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_REJECT)
+        self._restore = (s, [])
+        return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_ACCEPT)
+
+    def apply_snapshot_chunk(self, req: abci.RequestApplySnapshotChunk) -> abci.ResponseApplySnapshotChunk:
+        import hashlib
+
+        if self._restore is None:
+            return abci.ResponseApplySnapshotChunk(result=abci.APPLY_CHUNK_ABORT)
+        snap, chunks = self._restore
+        if req.index != len(chunks):
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_RETRY,
+                refetch_chunks=[len(chunks)])
+        chunks.append(req.chunk)
+        if len(chunks) < snap.chunks:
+            return abci.ResponseApplySnapshotChunk(result=abci.APPLY_CHUNK_ACCEPT)
+        blob = b"".join(chunks)
+        self._restore = None
+        if hashlib.sha256(blob).digest() != snap.hash:
+            # corrupt payload: refetch everything, distrust the senders
+            self._restore = (snap, [])
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_RETRY_SNAPSHOT,
+                reject_senders=[req.sender] if req.sender else [])
+        try:
+            self._deserialize_state(blob)
+        except Exception:  # noqa: BLE001 - malformed snapshot must not crash
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_REJECT_SNAPSHOT)
+        return abci.ResponseApplySnapshotChunk(result=abci.APPLY_CHUNK_ACCEPT)
 
     # --- helpers -----------------------------------------------------------
 

@@ -192,6 +192,16 @@ class BlockchainReactor(Reactor):
         self._thread = threading.Thread(target=self._pool_routine, daemon=True)
         self._thread.start()
 
+    def switch_to_fast_sync(self, state) -> None:
+        """Hand-off from state sync: resume fast sync from the bootstrapped
+        height (reference: blockchain/v0/reactor.go:109 SwitchToFastSync,
+        called from node.go:991 startStateSync)."""
+        self.state = state
+        self.initial_state = state
+        self.pool.height = state.last_block_height + 1
+        self.fast_sync = True
+        self.start_sync()
+
     def on_stop(self) -> None:
         self._running = False
 

@@ -128,22 +128,38 @@ class Node:
                              max_inbound=config.p2p.max_num_inbound_peers,
                              max_outbound=config.p2p.max_num_outbound_peers)
 
+        # state sync runs only on a fresh node (reference: node.go:991
+        # startStateSync is gated on state.LastBlockHeight == 0)
+        self._statesync_active = (config.statesync.enable
+                                  and state.last_block_height == 0)
         fast_sync = config.base.fast_sync_mode and len(self.genesis.validators) > 1
-        self.consensus_reactor = ConsensusReactor(self.consensus, wait_sync=fast_sync)
+        wait_sync = fast_sync or self._statesync_active
+        self.consensus_reactor = ConsensusReactor(self.consensus, wait_sync=wait_sync)
         self.mempool_reactor = MempoolReactor(self.mempool, broadcast=config.mempool.broadcast)
 
         from tendermint_tpu.blockchain.reactor import BlockchainReactor
         from tendermint_tpu.evidence.reactor import EvidenceReactor
+        from tendermint_tpu.statesync import StateSyncReactor, Syncer
 
         self.bc_reactor = BlockchainReactor(
             state, self.block_exec, self.block_store, fast_sync,
             self.consensus_reactor)
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
+        syncer = None
+        if self._statesync_active:
+            syncer = Syncer(
+                self.app, self._make_state_provider(),
+                chunk_request_timeout_s=config.statesync.chunk_request_timeout_s,
+                chunk_fetchers=config.statesync.chunk_fetchers)
+        # Reactor is registered unconditionally: every node SERVES snapshots
+        # from its app (reference: node.go:839 statesync.NewReactor).
+        self.statesync_reactor = StateSyncReactor(self.app, syncer)
 
         self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
         self.switch.add_reactor("BLOCKCHAIN", self.bc_reactor)
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
         self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
+        self.switch.add_reactor("STATESYNC", self.statesync_reactor)
 
         self.rpc_server = None
         self._tx_notify_thread = None
@@ -163,7 +179,11 @@ class Node:
         if self.config.p2p.persistent_peers:
             self.switch.add_persistent_peers(
                 self.config.p2p.persistent_peers.split(","))
-        if not self.consensus_reactor.wait_sync:
+        if self._statesync_active:
+            import threading
+
+            threading.Thread(target=self._run_state_sync, daemon=True).start()
+        elif not self.consensus_reactor.wait_sync:
             self.consensus.start()
         else:
             self.bc_reactor.start_sync()
@@ -195,6 +215,53 @@ class Node:
             self.rpc_server.stop()
         self.consensus.stop()
         self.switch.stop()
+
+    # --- state sync --------------------------------------------------------
+
+    def _make_state_provider(self):
+        """Light-client state provider over the configured RPC servers
+        (reference: node.go:648 startStateSync -> stateprovider.go:48)."""
+        from tendermint_tpu.light.client import TrustOptions
+        from tendermint_tpu.light.provider import HTTPProvider
+        from tendermint_tpu.statesync import LightClientStateProvider
+
+        cfg = self.config.statesync
+        servers = [s for s in cfg.rpc_servers if s]
+        if not servers:
+            raise ValueError("state sync requires statesync.rpc_servers")
+        if cfg.trust_height <= 0 or not cfg.trust_hash:
+            raise ValueError("state sync requires statesync.trust_height and trust_hash")
+        chain_id = self.genesis.chain_id
+        providers = [HTTPProvider(chain_id, s) for s in servers]
+        return LightClientStateProvider(
+            chain_id,
+            (self.genesis.consensus_params.version.app_version
+             if self.genesis.consensus_params else 0),
+            TrustOptions(period_s=cfg.trust_period_s, height=cfg.trust_height,
+                         hash=bytes.fromhex(cfg.trust_hash)),
+            providers[0], providers[1:],
+            consensus_params=self.genesis.consensus_params,
+            initial_height=self.genesis.initial_height,
+            logger=self.logger,
+        )
+
+    def _run_state_sync(self) -> None:
+        """Bootstrap from a snapshot, then hand off to fast sync (reference:
+        node.go:991 startStateSync)."""
+        cfg = self.config.statesync
+        try:
+            state, commit = self.statesync_reactor.sync(cfg.discovery_time_s)
+        except Exception as e:  # noqa: BLE001
+            if self.logger:
+                self.logger.error("state sync failed", err=e)
+            # Fall back to fast sync from genesis rather than hanging.
+            self.bc_reactor.start_sync()
+            return
+        self.state_store.bootstrap(state)
+        self.block_store.save_seen_commit(state.last_block_height, commit)
+        # consensus picks the state up via the fast-sync -> consensus handoff
+        # (ConsensusReactor.switch_to_consensus -> cs.update_to_state)
+        self.bc_reactor.switch_to_fast_sync(state)
 
     # --- helpers -----------------------------------------------------------
 

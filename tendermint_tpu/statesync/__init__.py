@@ -1,0 +1,35 @@
+"""State sync (reference: statesync/).
+
+Bootstrap a fresh node from an ABCI application snapshot instead of
+replaying every block: discover snapshots from peers (channel 0x60), offer
+the best one to the local app, fetch + apply its chunks (channel 0x61),
+then verify the restored app hash against a light-client-verified header
+and install the fetched State/Commit so fast sync can take over at
+height+1.
+
+ - snapshots: Snapshot + peer-tracking pool with ranking
+ - chunks: chunk queue for the snapshot being restored
+ - syncer: the offer/fetch/apply/verify state machine
+ - stateprovider: light-client-backed State/Commit/AppHash source
+ - reactor: p2p wiring (serving + syncing sides)
+"""
+
+from tendermint_tpu.statesync.reactor import (
+    CHUNK_CHANNEL,
+    SNAPSHOT_CHANNEL,
+    StateSyncReactor,
+)
+from tendermint_tpu.statesync.snapshots import Snapshot, SnapshotPool
+from tendermint_tpu.statesync.stateprovider import LightClientStateProvider
+from tendermint_tpu.statesync.syncer import SyncError, Syncer
+
+__all__ = [
+    "StateSyncReactor",
+    "SNAPSHOT_CHANNEL",
+    "CHUNK_CHANNEL",
+    "Snapshot",
+    "SnapshotPool",
+    "LightClientStateProvider",
+    "Syncer",
+    "SyncError",
+]

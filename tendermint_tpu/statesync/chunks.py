@@ -1,0 +1,97 @@
+"""Chunk queue for the snapshot being restored (reference:
+statesync/chunks.go).
+
+The reference spills chunks to a temp dir; chunks here stay in memory —
+snapshot chunks are bounded (the syncer fetches a window, applies in order,
+and discards), so the OS page cache indirection buys nothing on a TPU host
+with hundreds of GB of RAM.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class ChunkQueue:
+    """reference: statesync/chunks.go:27 chunkQueue."""
+
+    def __init__(self, num_chunks: int):
+        self.num_chunks = num_chunks
+        self._chunks: dict[int, tuple[bytes, str]] = {}  # index -> (body, sender)
+        self._allocated: dict[int, float] = {}  # index -> request time
+        self._returned: set[int] = set()  # applied indexes
+        self._cv = threading.Condition()
+        self._closed = False
+
+    def add(self, index: int, chunk: bytes, sender: str) -> bool:
+        """Store a fetched chunk; returns False for dupes/out-of-range
+        (reference: chunks.go:86 Add)."""
+        with self._cv:
+            if self._closed or not (0 <= index < self.num_chunks):
+                return False
+            if index in self._chunks or index in self._returned:
+                return False
+            self._chunks[index] = (chunk, sender)
+            self._allocated.pop(index, None)
+            self._cv.notify_all()
+            return True
+
+    def allocate(self, now: float, timeout: float) -> int | None:
+        """Next index worth requesting: unfetched and not recently requested
+        (reference: chunks.go:158 Allocate)."""
+        with self._cv:
+            for i in range(self.num_chunks):
+                if i in self._chunks or i in self._returned:
+                    continue
+                at = self._allocated.get(i)
+                if at is not None and now - at < timeout:
+                    continue
+                self._allocated[i] = now
+                return i
+            return None
+
+    def next(self, timeout: float) -> tuple[int, bytes, str] | None:
+        """Block until the NEXT in-order chunk is available (reference:
+        chunks.go:230 Next -- apply order is strict). The next wanted index
+        is the smallest unapplied one (retry() can reopen earlier indexes)."""
+        with self._cv:
+            while not self._closed:
+                want = min(
+                    (i for i in range(self.num_chunks) if i not in self._returned),
+                    default=None,
+                )
+                if want is None:
+                    return None
+                if want in self._chunks:
+                    body, sender = self._chunks.pop(want)
+                    self._returned.add(want)
+                    return want, body, sender
+                if not self._cv.wait(timeout):
+                    return None
+            return None
+
+    def retry(self, index: int) -> None:
+        """Re-queue an applied-but-rejected chunk (reference: chunks.go:260
+        Retry)."""
+        with self._cv:
+            self._returned.discard(index)
+            self._allocated.pop(index, None)
+
+    def discard_sender(self, sender: str) -> list[int]:
+        """Drop all unapplied chunks from a banned sender; returns the
+        indexes freed (reference: chunks.go:120 DiscardSender)."""
+        with self._cv:
+            freed = [i for i, (_, s) in self._chunks.items() if s == sender]
+            for i in freed:
+                del self._chunks[i]
+                self._allocated.pop(i, None)
+            return freed
+
+    def done(self) -> bool:
+        with self._cv:
+            return len(self._returned) >= self.num_chunks
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()

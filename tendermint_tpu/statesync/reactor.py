@@ -1,0 +1,152 @@
+"""State-sync p2p reactor (reference: statesync/reactor.go; channels
+Snapshot=0x60, Chunk=0x61; proto/tendermint/statesync/types.proto).
+
+Serving side answers SnapshotsRequest/ChunkRequest from the local app;
+syncing side feeds discovered snapshots + fetched chunks into the Syncer
+and drives one bootstrap attempt via `sync()`.
+
+Messages (oneof field numbers from the reference proto):
+  SnapshotsRequest=1{}, SnapshotsResponse=2{height,format,chunks,hash,metadata},
+  ChunkRequest=3{height,format,index}, ChunkResponse=4{height,format,index,chunk,missing}.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Peer, Reactor
+from tendermint_tpu.statesync.snapshots import RECENT_SNAPSHOTS, Snapshot
+from tendermint_tpu.statesync.syncer import Syncer
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+
+
+def msg_snapshots_request() -> bytes:
+    return proto.Writer().message(1, b"", always=True).out()
+
+
+def msg_snapshots_response(s: Snapshot) -> bytes:
+    inner = (proto.Writer().varint(1, s.height).varint(2, s.format)
+             .varint(3, s.chunks).bytes(4, s.hash).bytes(5, s.metadata).out())
+    return proto.Writer().message(2, inner, always=True).out()
+
+
+def msg_chunk_request(height: int, fmt: int, index: int) -> bytes:
+    inner = proto.Writer().varint(1, height).varint(2, fmt).varint(3, index).out()
+    return proto.Writer().message(3, inner, always=True).out()
+
+
+def msg_chunk_response(height: int, fmt: int, index: int, chunk: bytes,
+                       missing: bool) -> bytes:
+    w = proto.Writer().varint(1, height).varint(2, fmt).varint(3, index)
+    w.bytes(4, chunk)
+    if missing:
+        w.varint(5, 1)
+    return proto.Writer().message(4, w.out(), always=True).out()
+
+
+class StateSyncReactor(Reactor):
+    """reference: statesync/reactor.go:36."""
+
+    def __init__(self, app, syncer: Syncer | None = None, logger=None):
+        super().__init__("STATESYNC")
+        self.app = app  # local ABCI app, serving side
+        self.syncer = syncer  # set when this node wants to sync
+        self.logger = logger
+        if syncer is not None:
+            syncer.request_chunk = self._request_chunk
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        # priorities/capacities from reference reactor.go:58-77
+        return [
+            ChannelDescriptor(SNAPSHOT_CHANNEL, priority=5,
+                              recv_message_capacity=4 * 1024 * 1024),
+            ChannelDescriptor(CHUNK_CHANNEL, priority=3,
+                              recv_message_capacity=16 * 1024 * 1024),
+        ]
+
+    def add_peer(self, peer: Peer) -> None:
+        if self.syncer is not None:
+            peer.try_send(SNAPSHOT_CHANNEL, msg_snapshots_request())
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        if self.syncer is not None:
+            self.syncer.remove_peer(peer.id)
+
+    # --- receive ------------------------------------------------------------
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        f = proto.fields(msg_bytes)
+        if ch_id == SNAPSHOT_CHANNEL:
+            if 1 in f:  # SnapshotsRequest
+                self._serve_snapshots(peer)
+            elif 2 in f:  # SnapshotsResponse
+                m = proto.fields(f[2][-1])
+                s = Snapshot(
+                    height=proto.as_sint64(m.get(1, [0])[-1]),
+                    format=proto.as_sint64(m.get(2, [0])[-1]),
+                    chunks=proto.as_sint64(m.get(3, [0])[-1]),
+                    hash=m.get(4, [b""])[-1],
+                    metadata=m.get(5, [b""])[-1],
+                )
+                if self.syncer is not None:
+                    self.syncer.add_snapshot(peer.id, s)
+        elif ch_id == CHUNK_CHANNEL:
+            if 3 in f:  # ChunkRequest
+                m = proto.fields(f[3][-1])
+                self._serve_chunk(
+                    peer,
+                    proto.as_sint64(m.get(1, [0])[-1]),
+                    proto.as_sint64(m.get(2, [0])[-1]),
+                    proto.as_sint64(m.get(3, [0])[-1]),
+                )
+            elif 4 in f:  # ChunkResponse
+                m = proto.fields(f[4][-1])
+                index = proto.as_sint64(m.get(3, [0])[-1])
+                chunk = m.get(4, [b""])[-1]
+                missing = bool(proto.as_sint64(m.get(5, [0])[-1]))
+                if self.syncer is not None and not missing:
+                    self.syncer.add_chunk(index, chunk, peer.id)
+
+    # --- serving side (reference: reactor.go:106-170) -----------------------
+
+    def _serve_snapshots(self, peer: Peer) -> None:
+        try:
+            resp = self.app.list_snapshots(abci.RequestListSnapshots())
+        except Exception:  # noqa: BLE001 - peer input must not kill the reactor
+            return
+        for s in resp.snapshots[:RECENT_SNAPSHOTS]:
+            peer.try_send(SNAPSHOT_CHANNEL, msg_snapshots_response(Snapshot(
+                height=s.height, format=s.format, chunks=s.chunks,
+                hash=s.hash, metadata=s.metadata)))
+
+    def _serve_chunk(self, peer: Peer, height: int, fmt: int, index: int) -> None:
+        try:
+            resp = self.app.load_snapshot_chunk(abci.RequestLoadSnapshotChunk(
+                height=height, format=fmt, chunk=index))
+        except Exception:  # noqa: BLE001
+            resp = None
+        chunk = resp.chunk if resp is not None else b""
+        peer.try_send(CHUNK_CHANNEL, msg_chunk_response(
+            height, fmt, index, chunk, missing=not chunk))
+
+    # --- syncing side -------------------------------------------------------
+
+    def _request_chunk(self, peer_id: str, height: int, fmt: int, index: int) -> None:
+        if self.switch is None:
+            return
+        with self.switch._peers_mtx:
+            p = self.switch.peers.get(peer_id)
+        if p is not None:
+            p.try_send(CHUNK_CHANNEL, msg_chunk_request(height, fmt, index))
+
+    def sync(self, discovery_time_s: float, give_up_after_s: float = 120.0):
+        """Run one bootstrap attempt; returns (state, commit) (reference:
+        reactor.go:282 Sync)."""
+        if self.syncer is None:
+            raise RuntimeError("reactor has no syncer configured")
+        if self.switch is not None:
+            self.switch.broadcast(SNAPSHOT_CHANNEL, msg_snapshots_request())
+        return self.syncer.sync_any(discovery_time_s, give_up_after_s)

@@ -1,0 +1,114 @@
+"""Snapshot metadata + discovery pool (reference: statesync/snapshots.go)."""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass, field
+
+# Limit on snapshots advertised per peer (reference:
+# statesync/snapshots.go:16 recentSnapshots=10).
+RECENT_SNAPSHOTS = 10
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    """reference: statesync/snapshots.go:20-36."""
+
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+    metadata: bytes = b""
+
+    def key(self) -> bytes:
+        """Distinct snapshots may share height/format; identity includes the
+        content hash (reference: snapshots.go:29 Key)."""
+        h = hashlib.sha256()
+        h.update(self.height.to_bytes(8, "big"))
+        h.update(self.format.to_bytes(4, "big"))
+        h.update(self.chunks.to_bytes(4, "big"))
+        h.update(self.hash)
+        return h.digest()
+
+
+@dataclass
+class _Entry:
+    snapshot: Snapshot
+    peers: set = field(default_factory=set)
+
+
+class SnapshotPool:
+    """Tracks discovered snapshots and which peers have them (reference:
+    statesync/snapshots.go:55 snapshotPool)."""
+
+    def __init__(self) -> None:
+        self._entries: dict[bytes, _Entry] = {}
+        self._rejected: set[bytes] = set()
+        self._rejected_formats: set[int] = set()
+        self._rejected_peers: set[str] = set()
+        self._mtx = threading.Lock()
+
+    def add(self, peer_id: str, snapshot: Snapshot) -> bool:
+        """Returns True when this snapshot is new (reference:
+        snapshots.go:93 Add)."""
+        key = snapshot.key()
+        with self._mtx:
+            if (key in self._rejected or snapshot.format in self._rejected_formats
+                    or peer_id in self._rejected_peers):
+                return False
+            e = self._entries.get(key)
+            if e is None:
+                self._entries[key] = _Entry(snapshot, {peer_id})
+                return True
+            e.peers.add(peer_id)
+            return False
+
+    def best(self) -> Snapshot | None:
+        """Highest height wins, then newest format (reference:
+        snapshots.go:165 Best)."""
+        ranked = self.ranked()
+        return ranked[0] if ranked else None
+
+    def ranked(self) -> list[Snapshot]:
+        with self._mtx:
+            entries = [e for e in self._entries.values() if e.peers]
+            entries.sort(key=lambda e: (-e.snapshot.height, -e.snapshot.format))
+            return [e.snapshot for e in entries]
+
+    def peers_of(self, snapshot: Snapshot) -> list[str]:
+        with self._mtx:
+            e = self._entries.get(snapshot.key())
+            return sorted(e.peers) if e else []
+
+    def reject(self, snapshot: Snapshot) -> None:
+        """reference: snapshots.go:205 Reject."""
+        with self._mtx:
+            key = snapshot.key()
+            self._rejected.add(key)
+            self._entries.pop(key, None)
+
+    def reject_format(self, fmt: int) -> None:
+        """reference: snapshots.go:215 RejectFormat."""
+        with self._mtx:
+            self._rejected_formats.add(fmt)
+            for key in [k for k, e in self._entries.items()
+                        if e.snapshot.format == fmt]:
+                del self._entries[key]
+
+    def reject_peer(self, peer_id: str) -> None:
+        """reference: snapshots.go:226 RejectPeer."""
+        with self._mtx:
+            self._rejected_peers.add(peer_id)
+            self._remove_peer_locked(peer_id)
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._mtx:
+            self._remove_peer_locked(peer_id)
+
+    def _remove_peer_locked(self, peer_id: str) -> None:
+        for key in list(self._entries):
+            e = self._entries[key]
+            e.peers.discard(peer_id)
+            if not e.peers:
+                del self._entries[key]

@@ -1,0 +1,223 @@
+"""The state-sync state machine (reference: statesync/syncer.go).
+
+Drives one restore attempt end to end: pick the best discovered snapshot,
+offer it to the local ABCI app, fetch + apply chunks in order, then verify
+the restored app hash against the light-client state provider.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.statesync.chunks import ChunkQueue
+from tendermint_tpu.statesync.snapshots import Snapshot, SnapshotPool
+
+
+class SyncError(Exception):
+    pass
+
+
+class ErrNoSnapshots(SyncError):
+    """reference: statesync/syncer.go:31 errNoSnapshots."""
+
+
+class ErrAbort(SyncError):
+    """App aborted the snapshot restore (reference: syncer.go:27 errAbort)."""
+
+
+class ErrRejectSnapshot(SyncError):
+    pass
+
+
+class ErrRejectFormat(SyncError):
+    pass
+
+
+class ErrVerifyFailed(SyncError):
+    """Restored app hash does not match the trusted header (reference:
+    syncer.go:35 errVerifyFailed)."""
+
+
+class Syncer:
+    """reference: statesync/syncer.go:49 syncer."""
+
+    def __init__(self, app, state_provider, *, chunk_request_timeout_s: float = 10.0,
+                 chunk_fetchers: int = 4, logger=None):
+        self.app = app  # ABCI snapshot connection (Application)
+        self.state_provider = state_provider
+        self.pool = SnapshotPool()
+        self.chunk_request_timeout_s = chunk_request_timeout_s
+        self.chunk_fetchers = chunk_fetchers
+        self.logger = logger
+        self._chunks: ChunkQueue | None = None
+        self._mtx = threading.Lock()
+        # set by the reactor: fn(peer_id, height, format, index) requesting a
+        # chunk from a peer over channel 0x61
+        self.request_chunk = lambda peer_id, height, fmt, index: None
+
+    # --- discovery input ----------------------------------------------------
+
+    def add_snapshot(self, peer_id: str, snapshot: Snapshot) -> bool:
+        return self.pool.add(peer_id, snapshot)
+
+    def add_chunk(self, index: int, chunk: bytes, sender: str) -> bool:
+        with self._mtx:
+            q = self._chunks
+        return q.add(index, chunk, sender) if q is not None else False
+
+    def remove_peer(self, peer_id: str) -> None:
+        self.pool.remove_peer(peer_id)
+
+    # --- the sync loop (reference: syncer.go:145 SyncAny) -------------------
+
+    def sync_any(self, discovery_time_s: float, give_up_after_s: float = 120.0):
+        """Try snapshots best-first until one restores and verifies.
+        Returns (state, commit)."""
+        deadline = time.monotonic() + give_up_after_s
+        tried: set[bytes] = set()
+        while time.monotonic() < deadline:
+            snapshot = None
+            for s in self.pool.ranked():
+                if s.key() not in tried:
+                    snapshot = s
+                    break
+            if snapshot is None:
+                time.sleep(min(discovery_time_s, 0.1))
+                continue
+            tried.add(snapshot.key())
+            try:
+                return self.sync(snapshot)
+            except ErrRejectSnapshot:
+                self.pool.reject(snapshot)
+            except ErrRejectFormat:
+                self.pool.reject_format(snapshot.format)
+            except ErrVerifyFailed:
+                # Snapshot content didn't match the trusted app hash: ban the
+                # peers that advertised it (reference: syncer.go:168-178).
+                for pid in self.pool.peers_of(snapshot):
+                    self.pool.reject_peer(pid)
+                self.pool.reject(snapshot)
+            except ErrAbort:
+                raise
+        raise ErrNoSnapshots("no viable snapshot found before deadline")
+
+    def sync(self, snapshot: Snapshot):
+        """Restore one snapshot (reference: syncer.go:241 Sync)."""
+        # 1. Trusted app hash for this height MUST exist before offering
+        #    (reference: syncer.go:259 -- never feed the app unverified data).
+        app_hash = self.state_provider.app_hash(snapshot.height)
+
+        # 2. Offer to the app.
+        self._offer_snapshot(snapshot, app_hash)
+
+        # 3. Fetch + apply chunks.
+        with self._mtx:
+            self._chunks = ChunkQueue(snapshot.chunks)
+        try:
+            fetchers = [
+                threading.Thread(target=self._fetch_routine, args=(snapshot,),
+                                 daemon=True)
+                for _ in range(min(self.chunk_fetchers, max(snapshot.chunks, 1)))
+            ]
+            for f in fetchers:
+                f.start()
+            self._apply_chunks(snapshot)
+        finally:
+            with self._mtx:
+                q, self._chunks = self._chunks, None
+            if q is not None:
+                q.close()
+
+        # 4. Verify the restored app against the trusted header
+        #    (reference: syncer.go:432 verifyApp).
+        info = self.app.info(abci.RequestInfo())
+        if info.last_block_app_hash != app_hash:
+            raise ErrVerifyFailed(
+                f"app hash mismatch after restore: expected {app_hash.hex()}, "
+                f"got {info.last_block_app_hash.hex()}")
+        if info.last_block_height != snapshot.height:
+            raise ErrVerifyFailed(
+                f"app height mismatch: expected {snapshot.height}, "
+                f"got {info.last_block_height}")
+
+        # 5. Fetch the State + Commit the node resumes from.
+        state = self.state_provider.state(snapshot.height)
+        commit = self.state_provider.commit(snapshot.height)
+        return state, commit
+
+    # --- internals ----------------------------------------------------------
+
+    def _offer_snapshot(self, snapshot: Snapshot, app_hash: bytes) -> None:
+        """reference: syncer.go:322 offerSnapshot."""
+        resp = self.app.offer_snapshot(abci.RequestOfferSnapshot(
+            snapshot=abci.Snapshot(
+                height=snapshot.height, format=snapshot.format,
+                chunks=snapshot.chunks, hash=snapshot.hash,
+                metadata=snapshot.metadata),
+            app_hash=app_hash,
+        ))
+        r = resp.result
+        if r == abci.OFFER_SNAPSHOT_ACCEPT:
+            return
+        if r == abci.OFFER_SNAPSHOT_ABORT:
+            raise ErrAbort("app aborted state sync")
+        if r == abci.OFFER_SNAPSHOT_REJECT:
+            raise ErrRejectSnapshot("app rejected snapshot")
+        if r == abci.OFFER_SNAPSHOT_REJECT_FORMAT:
+            raise ErrRejectFormat(f"app rejected format {snapshot.format}")
+        if r == abci.OFFER_SNAPSHOT_REJECT_SENDER:
+            raise ErrRejectSnapshot("app rejected snapshot senders")
+        raise SyncError(f"unknown OfferSnapshot result {r}")
+
+    def _fetch_routine(self, snapshot: Snapshot) -> None:
+        """Request unfetched chunks from peers that have this snapshot
+        (reference: syncer.go:380 fetchChunks)."""
+        while True:
+            with self._mtx:
+                q = self._chunks
+            if q is None or q.done():
+                return
+            idx = q.allocate(time.monotonic(), self.chunk_request_timeout_s)
+            if idx is None:
+                time.sleep(0.05)
+                continue
+            peers = self.pool.peers_of(snapshot)
+            if not peers:
+                time.sleep(0.1)
+                continue
+            peer = peers[idx % len(peers)]
+            self.request_chunk(peer, snapshot.height, snapshot.format, idx)
+            time.sleep(0.01)
+
+    def _apply_chunks(self, snapshot: Snapshot) -> None:
+        """Apply in strict order, honoring refetch/ban feedback (reference:
+        syncer.go:358 applyChunks)."""
+        with self._mtx:
+            q = self._chunks
+        while not q.done():
+            nxt = q.next(self.chunk_request_timeout_s * 2)
+            if nxt is None:
+                raise SyncError("timed out waiting for chunk")
+            index, body, sender = nxt
+            resp = self.app.apply_snapshot_chunk(abci.RequestApplySnapshotChunk(
+                index=index, chunk=body, sender=sender))
+            for s in resp.reject_senders:
+                self.pool.reject_peer(s)
+                for freed in q.discard_sender(s):
+                    q.retry(freed)
+            for r in resp.refetch_chunks:
+                q.retry(r)
+            if resp.result == abci.APPLY_CHUNK_ACCEPT:
+                continue
+            if resp.result == abci.APPLY_CHUNK_RETRY:
+                q.retry(index)
+                continue
+            if resp.result == abci.APPLY_CHUNK_RETRY_SNAPSHOT:
+                raise ErrRejectSnapshot("app requested snapshot retry")
+            if resp.result == abci.APPLY_CHUNK_ABORT:
+                raise ErrAbort("app aborted during chunk apply")
+            if resp.result == abci.APPLY_CHUNK_REJECT_SNAPSHOT:
+                raise ErrRejectSnapshot("app rejected snapshot during apply")
+            raise SyncError(f"unknown ApplySnapshotChunk result {resp.result}")

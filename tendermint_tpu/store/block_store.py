@@ -171,6 +171,12 @@ class BlockStore:
             sets.append((_STATE_KEY, self._state_bytes()))
             self._db.write_batch(sets)
 
+    def save_seen_commit(self, height: int, seen_commit: Commit) -> None:
+        """Standalone seen-commit write for the state-sync bootstrap
+        (reference: store/store.go:385 SaveSeenCommit)."""
+        with self._mtx:
+            self._db.set(_seen_commit_key(height), seen_commit.marshal())
+
     def prune_blocks(self, height: int) -> int:
         """Removes blocks below `height`, keeping `height` (reference:
         store/store.go:248-330). Returns number pruned."""
