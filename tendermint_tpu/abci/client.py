@@ -1,0 +1,132 @@
+"""ABCI socket client: the Application interface over a TCP/unix socket
+(reference: abci/client/socket_client.go:27).
+
+Drop-in for an in-process Application: implements the same 13 methods with
+the same request/response dataclasses, so Mempool/BlockExecutor/Syncer don't
+know whether the app is in-process or remote. Thread-safe; one in-flight
+request at a time per client (the proxy gives each subsystem its own client,
+so consensus is never blocked behind mempool traffic).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci import wire
+
+
+class ABCIClientError(Exception):
+    pass
+
+
+class ABCISocketClient:
+    def __init__(self, addr: str, timeout_s: float = 10.0,
+                 connect_retries: int = 20, retry_interval_s: float = 0.25):
+        self.addr = addr
+        self.timeout_s = timeout_s
+        self._mtx = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._rfile = None
+        self._wfile = None
+        self._connect(connect_retries, retry_interval_s)
+
+    def _connect(self, retries: int, interval: float) -> None:
+        proto_, rest = self.addr.split("://", 1)
+        last_err = None
+        for _ in range(max(retries, 1)):
+            try:
+                if proto_ == "unix":
+                    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                    s.settimeout(self.timeout_s)
+                    s.connect(rest)
+                elif proto_ == "tcp":
+                    host, port = rest.rsplit(":", 1)
+                    s = socket.create_connection((host, int(port)),
+                                                 timeout=self.timeout_s)
+                else:
+                    raise ABCIClientError(f"unsupported address {self.addr!r}")
+                self._sock = s
+                self._rfile = s.makefile("rb")
+                self._wfile = s.makefile("wb")
+                return
+            except OSError as e:
+                last_err = e
+                time.sleep(interval)
+        raise ABCIClientError(f"could not connect to {self.addr}: {last_err}")
+
+    def close(self) -> None:
+        with self._mtx:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    def _call(self, kind: str, req=None):
+        with self._mtx:
+            if self._sock is None:
+                raise ABCIClientError("client is closed")
+            try:
+                wire.write_delimited(self._wfile, wire.encode_request(kind, req))
+                self._wfile.flush()
+                buf = wire.read_delimited(self._rfile)
+            except (OSError, EOFError) as e:
+                raise ABCIClientError(f"ABCI connection failed: {e}") from e
+            if buf is None:
+                raise ABCIClientError("ABCI server closed the connection")
+            got_kind, resp = wire.decode_response(buf)
+            if got_kind != kind:
+                raise ABCIClientError(
+                    f"unexpected response {got_kind!r} to request {kind!r}")
+            return resp
+
+    # --- the Application surface -------------------------------------------
+
+    def echo(self, msg: str) -> str:
+        return self._call(wire.ECHO, msg)
+
+    def flush(self) -> None:
+        self._call(wire.FLUSH)
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return self._call("info", req)
+
+    def set_option(self, key: str, value: str) -> abci.ResponseSetOption:
+        return self._call("set_option", (key, value))
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        return self._call("query", req)
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        return self._call("check_tx", req)
+
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        return self._call("init_chain", req)
+
+    def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        return self._call("begin_block", req)
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        return self._call("deliver_tx", req)
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        return self._call("end_block", req)
+
+    def commit(self) -> abci.ResponseCommit:
+        return self._call(wire.COMMIT)
+
+    def list_snapshots(self, req: abci.RequestListSnapshots) -> abci.ResponseListSnapshots:
+        return self._call("list_snapshots", req)
+
+    def offer_snapshot(self, req: abci.RequestOfferSnapshot) -> abci.ResponseOfferSnapshot:
+        return self._call("offer_snapshot", req)
+
+    def load_snapshot_chunk(self, req: abci.RequestLoadSnapshotChunk) -> abci.ResponseLoadSnapshotChunk:
+        return self._call("load_snapshot_chunk", req)
+
+    def apply_snapshot_chunk(self, req: abci.RequestApplySnapshotChunk) -> abci.ResponseApplySnapshotChunk:
+        return self._call("apply_snapshot_chunk", req)

@@ -1,0 +1,100 @@
+"""AppConns: the 4-connection ABCI proxy multiplexer (reference:
+proxy/multi_app_conn.go:21, proxy/client.go:17,75).
+
+Each subsystem gets its own logical connection so consensus block execution
+is never queued behind mempool CheckTx traffic:
+  consensus -- BeginBlock/DeliverTx/EndBlock/Commit (BlockExecutor, replay)
+  mempool   -- CheckTx
+  query     -- Info/Query (RPC, handshake)
+  snapshot  -- ListSnapshots/OfferSnapshot/...Chunk (state sync)
+
+For an in-process app all four share the app object behind one mutex
+(reference: abci/client/local_client.go). For a remote app each connection
+is its own socket (reference: proxy/multi_app_conn.go:56-96).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from tendermint_tpu.abci import types as abci
+
+_APP_METHODS = (
+    "info", "set_option", "query", "check_tx", "init_chain", "begin_block",
+    "deliver_tx", "end_block", "commit", "list_snapshots", "offer_snapshot",
+    "load_snapshot_chunk", "apply_snapshot_chunk",
+)
+
+
+class LocalClient:
+    """In-proc connection: shared app + shared mutex (reference:
+    abci/client/local_client.go:14 -- one mutex across all local clients)."""
+
+    def __init__(self, app: abci.Application, mtx: threading.RLock):
+        self._app = app
+        self._mtx = mtx
+
+    def __getattr__(self, name):
+        if name not in _APP_METHODS:
+            raise AttributeError(name)
+        fn = getattr(self._app, name)
+
+        def call(*args, **kwargs):
+            with self._mtx:
+                return fn(*args, **kwargs)
+
+        return call
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+@dataclass
+class AppConns:
+    """reference: proxy/multi_app_conn.go:21 AppConns interface."""
+
+    consensus: object
+    mempool: object
+    query: object
+    snapshot: object
+
+    def stop(self) -> None:
+        for c in (self.consensus, self.mempool, self.query, self.snapshot):
+            close = getattr(c, "close", None)
+            if close:
+                close()
+
+
+def local_app_conns(app: abci.Application) -> AppConns:
+    """reference: proxy/client.go:33 NewLocalClientCreator."""
+    mtx = threading.RLock()
+    return AppConns(
+        consensus=LocalClient(app, mtx),
+        mempool=LocalClient(app, mtx),
+        query=LocalClient(app, mtx),
+        snapshot=LocalClient(app, mtx),
+    )
+
+
+def socket_app_conns(addr: str, timeout_s: float = 10.0) -> AppConns:
+    """Four independent sockets to one app server (reference:
+    proxy/client.go:56 NewRemoteClientCreator + multi_app_conn.go:56)."""
+    from tendermint_tpu.abci.client import ABCISocketClient
+
+    return AppConns(
+        consensus=ABCISocketClient(addr, timeout_s),
+        mempool=ABCISocketClient(addr, timeout_s),
+        query=ABCISocketClient(addr, timeout_s),
+        snapshot=ABCISocketClient(addr, timeout_s),
+    )
+
+
+def new_app_conns(app_or_addr) -> AppConns:
+    """In-proc Application object or a tcp://|unix:// address string."""
+    if isinstance(app_or_addr, str):
+        return socket_app_conns(app_or_addr)
+    return local_app_conns(app_or_addr)

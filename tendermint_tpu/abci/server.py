@@ -1,0 +1,126 @@
+"""ABCI socket server: runs an Application behind a TCP or unix socket
+(reference: abci/server/socket_server.go).
+
+One global app mutex serializes requests across all connections, exactly
+like the reference (socket_server.go:19 "concurrency is not allowed").
+Responses go back on the connection the request arrived on, in order.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci import wire
+
+
+def _dispatch(app, kind: str, req):
+    if kind == wire.ECHO:
+        return req
+    if kind == wire.FLUSH:
+        return None
+    if kind == wire.COMMIT:
+        return app.commit()
+    if kind == "set_option":
+        return app.set_option(*req)
+    return getattr(app, kind)(req)
+
+
+class ABCIServer:
+    """reference: abci/server/socket_server.go:21 SocketServer."""
+
+    def __init__(self, app: abci.Application, addr: str, logger=None):
+        self.app = app
+        self.addr = addr
+        self.logger = logger
+        self._app_mtx = threading.Lock()
+        self._listener: socket.socket | None = None
+        self._conns: list[socket.socket] = []
+        self._running = False
+        self._accept_thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        proto_, rest = self.addr.split("://", 1)
+        if proto_ == "unix":
+            if os.path.exists(rest):
+                os.unlink(rest)
+            self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._listener.bind(rest)
+        elif proto_ == "tcp":
+            host, port = rest.rsplit(":", 1)
+            self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._listener.bind((host, int(port)))
+            if int(port) == 0:
+                host_, port_ = self._listener.getsockname()[:2]
+                self.addr = f"tcp://{host_}:{port_}"
+        else:
+            raise ValueError(f"unsupported ABCI server address {self.addr!r}")
+        self._listener.listen(8)
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_routine, name="abci-accept", daemon=True)
+        self._accept_thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        for c in list(self._conns):
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _accept_routine(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            threading.Thread(target=self._conn_routine, args=(conn,),
+                             daemon=True).start()
+
+    def _conn_routine(self, conn: socket.socket) -> None:
+        """reference: socket_server.go:164 handleRequests."""
+        rfile = conn.makefile("rb")
+        wfile = conn.makefile("wb")
+        try:
+            while self._running:
+                buf = wire.read_delimited(rfile)
+                if buf is None:
+                    return
+                try:
+                    kind, req = wire.decode_request(buf)
+                except ValueError as e:
+                    wire.write_delimited(
+                        wfile, wire.encode_response("", error=f"bad request: {e}"))
+                    wfile.flush()
+                    return
+                try:
+                    with self._app_mtx:
+                        resp = _dispatch(self.app, kind, req)
+                    out = wire.encode_response(kind, resp)
+                except Exception as e:  # noqa: BLE001 - app panic -> exception resp
+                    out = wire.encode_response(kind, error=str(e))
+                wire.write_delimited(wfile, out)
+                # Flush every response: our clients call synchronously (each
+                # request is its own round trip), and eager flushing keeps a
+                # pipelining client correct too -- unlike the reference server,
+                # which buffers until a Flush request (socket_server.go:164).
+                wfile.flush()
+        except (EOFError, OSError, ValueError):
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if conn in self._conns:
+                self._conns.remove(conn)

@@ -1,0 +1,461 @@
+"""ABCI wire codec: Request/Response oneof encoding + varint-delimited
+framing (reference: proto/tendermint/abci/types.proto, abci/types/messages.go
+WriteMessage/ReadMessage).
+
+Oneof field numbers match the reference proto exactly, so this codec is
+wire-compatible with a Go tendermint v0.34 socket app:
+  Request:  echo=1 flush=2 info=3 set_option=4 init_chain=5 query=6
+            begin_block=7 check_tx=8 deliver_tx=9 end_block=10 commit=11
+            list_snapshots=12 offer_snapshot=13 load_snapshot_chunk=14
+            apply_snapshot_chunk=15
+  Response: exception=1 echo=2 flush=3 info=4 set_option=5 init_chain=6
+            query=7 begin_block=8 check_tx=9 deliver_tx=10 end_block=11
+            commit=12 list_snapshots=13 offer_snapshot=14
+            load_snapshot_chunk=15 apply_snapshot_chunk=16
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.encoding import proto
+
+# reference: abci/types/messages.go:12-26
+MAX_MSG_SIZE = 100 * 1024 * 1024
+
+
+# --- framing (uvarint length prefix, reference libs/protoio) ----------------
+
+
+def write_delimited(sock_file, msg: bytes) -> None:
+    sock_file.write(proto.encode_uvarint(len(msg)) + msg)
+
+
+def read_delimited(sock_file) -> bytes | None:
+    """Returns None on clean EOF; raises on truncation/oversize."""
+    shift = 0
+    length = 0
+    while True:
+        b = sock_file.read(1)
+        if not b:
+            if shift == 0:
+                return None
+            raise EOFError("truncated varint length prefix")
+        length |= (b[0] & 0x7F) << shift
+        if not b[0] & 0x80:
+            break
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint length prefix too long")
+    if length > MAX_MSG_SIZE:
+        raise ValueError(f"message size {length} exceeds {MAX_MSG_SIZE}")
+    out = b""
+    while len(out) < length:
+        chunk = sock_file.read(length - len(out))
+        if not chunk:
+            raise EOFError("truncated message body")
+        out += chunk
+    return out
+
+
+# --- sub-message codecs -----------------------------------------------------
+
+
+def _ts(seconds: int, nanos: int) -> bytes:
+    return proto.Writer().varint(1, seconds).varint(2, nanos).out()
+
+
+def _snapshot_marshal(s: abci.Snapshot) -> bytes:
+    return (proto.Writer().uvarint(1, s.height).uvarint(2, s.format)
+            .uvarint(3, s.chunks).bytes(4, s.hash).bytes(5, s.metadata).out())
+
+
+def _snapshot_unmarshal(buf: bytes) -> abci.Snapshot:
+    f = proto.fields(buf)
+    return abci.Snapshot(
+        height=f.get(1, [0])[-1], format=f.get(2, [0])[-1],
+        chunks=f.get(3, [0])[-1], hash=f.get(4, [b""])[-1],
+        metadata=f.get(5, [b""])[-1])
+
+
+def _abci_validator_marshal(v: abci.ABCIValidator) -> bytes:
+    # power is field 3 in the reference proto (types.proto Validator)
+    return proto.Writer().bytes(1, v.address).varint(3, v.power).out()
+
+
+def _abci_validator_unmarshal(buf: bytes) -> abci.ABCIValidator:
+    f = proto.fields(buf)
+    return abci.ABCIValidator(address=f.get(1, [b""])[-1],
+                              power=proto.as_sint64(f.get(3, [0])[-1]))
+
+
+def _last_commit_info_marshal(lci: abci.LastCommitInfo) -> bytes:
+    w = proto.Writer().varint(1, lci.round)
+    for v in lci.votes:
+        inner = proto.Writer().message(
+            1, _abci_validator_marshal(v.validator), always=True
+        ).bool(2, v.signed_last_block).out()
+        w.message(2, inner, always=True)
+    return w.out()
+
+
+def _last_commit_info_unmarshal(buf: bytes) -> abci.LastCommitInfo:
+    f = proto.fields(buf)
+    votes = []
+    for vb in f.get(2, []):
+        vf = proto.fields(vb)
+        votes.append(abci.VoteInfo(
+            validator=_abci_validator_unmarshal(vf.get(1, [b""])[-1]),
+            signed_last_block=bool(vf.get(2, [0])[-1])))
+    return abci.LastCommitInfo(round=proto.as_sint64(f.get(1, [0])[-1]),
+                               votes=votes)
+
+
+def _evidence_marshal(e: abci.ABCIEvidence) -> bytes:
+    return (proto.Writer().varint(1, e.type)
+            .message(2, _abci_validator_marshal(e.validator), always=True)
+            .varint(3, e.height)
+            .message(4, _ts(e.time_seconds, e.time_nanos), always=True)
+            .varint(5, e.total_voting_power).out())
+
+
+def _evidence_unmarshal(buf: bytes) -> abci.ABCIEvidence:
+    f = proto.fields(buf)
+    tsf = proto.fields(f.get(4, [b""])[-1])
+    return abci.ABCIEvidence(
+        type=proto.as_sint64(f.get(1, [0])[-1]),
+        validator=_abci_validator_unmarshal(f.get(2, [b""])[-1]),
+        height=proto.as_sint64(f.get(3, [0])[-1]),
+        time_seconds=proto.as_sint64(tsf.get(1, [0])[-1]),
+        time_nanos=proto.as_sint64(tsf.get(2, [0])[-1]),
+        total_voting_power=proto.as_sint64(f.get(5, [0])[-1]))
+
+
+def _events_marshal(w: proto.Writer, fieldnum: int, events) -> None:
+    for e in events:
+        w.message(fieldnum, e.marshal(), always=True)
+
+
+# --- request encode/decode --------------------------------------------------
+
+ECHO, FLUSH, COMMIT = "echo", "flush", "commit"
+
+
+def encode_request(kind: str, req=None) -> bytes:
+    w = proto.Writer()
+    if kind == ECHO:
+        w.message(1, proto.Writer().string(1, req or "").out(), always=True)
+    elif kind == FLUSH:
+        w.message(2, b"", always=True)
+    elif kind == "info":
+        inner = (proto.Writer().string(1, req.version)
+                 .uvarint(2, req.block_version).uvarint(3, req.p2p_version).out())
+        w.message(3, inner, always=True)
+    elif kind == "init_chain":
+        iw = proto.Writer().message(1, _ts(req.time_seconds, req.time_nanos), always=True)
+        iw.string(2, req.chain_id)
+        if req.consensus_params is not None:
+            iw.message(3, req.consensus_params.marshal(), always=True)
+        for v in req.validators:
+            iw.message(4, v.marshal(), always=True)
+        iw.bytes(5, req.app_state_bytes).varint(6, req.initial_height)
+        w.message(5, iw.out(), always=True)
+    elif kind == "query":
+        inner = (proto.Writer().bytes(1, req.data).string(2, req.path)
+                 .varint(3, req.height).bool(4, req.prove).out())
+        w.message(6, inner, always=True)
+    elif kind == "begin_block":
+        bw = proto.Writer().bytes(1, req.hash)
+        if req.header is not None:
+            bw.message(2, req.header.marshal(), always=True)
+        bw.message(3, _last_commit_info_marshal(req.last_commit_info), always=True)
+        for e in req.byzantine_validators:
+            bw.message(4, _evidence_marshal(e), always=True)
+        w.message(7, bw.out(), always=True)
+    elif kind == "check_tx":
+        inner = proto.Writer().bytes(1, req.tx).varint(2, req.type).out()
+        w.message(8, inner, always=True)
+    elif kind == "deliver_tx":
+        w.message(9, proto.Writer().bytes(1, req.tx).out(), always=True)
+    elif kind == "end_block":
+        w.message(10, proto.Writer().varint(1, req.height).out(), always=True)
+    elif kind == COMMIT:
+        w.message(11, b"", always=True)
+    elif kind == "list_snapshots":
+        w.message(12, b"", always=True)
+    elif kind == "offer_snapshot":
+        ow = proto.Writer()
+        if req.snapshot is not None:
+            ow.message(1, _snapshot_marshal(req.snapshot), always=True)
+        ow.bytes(2, req.app_hash)
+        w.message(13, ow.out(), always=True)
+    elif kind == "load_snapshot_chunk":
+        inner = (proto.Writer().uvarint(1, req.height).uvarint(2, req.format)
+                 .uvarint(3, req.chunk).out())
+        w.message(14, inner, always=True)
+    elif kind == "apply_snapshot_chunk":
+        inner = (proto.Writer().uvarint(1, req.index).bytes(2, req.chunk)
+                 .string(3, req.sender).out())
+        w.message(15, inner, always=True)
+    else:
+        raise ValueError(f"unknown request kind {kind!r}")
+    return w.out()
+
+
+def decode_request(buf: bytes) -> tuple[str, object]:
+    from tendermint_tpu.types.block import Header
+    from tendermint_tpu.types.params import ConsensusParams
+
+    f = proto.fields(buf)
+    if 1 in f:
+        return ECHO, proto.fields(f[1][-1]).get(1, [b""])[-1].decode()
+    if 2 in f:
+        return FLUSH, None
+    if 3 in f:
+        m = proto.fields(f[3][-1])
+        return "info", abci.RequestInfo(
+            version=m.get(1, [b""])[-1].decode() if 1 in m else "",
+            block_version=m.get(2, [0])[-1], p2p_version=m.get(3, [0])[-1])
+    if 5 in f:
+        m = proto.fields(f[5][-1])
+        tsf = proto.fields(m.get(1, [b""])[-1])
+        return "init_chain", abci.RequestInitChain(
+            time_seconds=proto.as_sint64(tsf.get(1, [0])[-1]),
+            time_nanos=proto.as_sint64(tsf.get(2, [0])[-1]),
+            chain_id=m.get(2, [b""])[-1].decode() if 2 in m else "",
+            consensus_params=ConsensusParams.unmarshal(m[3][-1]) if 3 in m else None,
+            validators=[abci.ValidatorUpdate.unmarshal(b) for b in m.get(4, [])],
+            app_state_bytes=m.get(5, [b""])[-1],
+            initial_height=proto.as_sint64(m.get(6, [0])[-1]))
+    if 6 in f:
+        m = proto.fields(f[6][-1])
+        return "query", abci.RequestQuery(
+            data=m.get(1, [b""])[-1],
+            path=m.get(2, [b""])[-1].decode() if 2 in m else "",
+            height=proto.as_sint64(m.get(3, [0])[-1]),
+            prove=bool(m.get(4, [0])[-1]))
+    if 7 in f:
+        m = proto.fields(f[7][-1])
+        return "begin_block", abci.RequestBeginBlock(
+            hash=m.get(1, [b""])[-1],
+            header=Header.unmarshal(m[2][-1]) if 2 in m else None,
+            last_commit_info=_last_commit_info_unmarshal(m.get(3, [b""])[-1]),
+            byzantine_validators=[_evidence_unmarshal(b) for b in m.get(4, [])])
+    if 8 in f:
+        m = proto.fields(f[8][-1])
+        return "check_tx", abci.RequestCheckTx(
+            tx=m.get(1, [b""])[-1], type=proto.as_sint64(m.get(2, [0])[-1]))
+    if 9 in f:
+        return "deliver_tx", abci.RequestDeliverTx(
+            tx=proto.fields(f[9][-1]).get(1, [b""])[-1])
+    if 10 in f:
+        return "end_block", abci.RequestEndBlock(
+            height=proto.as_sint64(proto.fields(f[10][-1]).get(1, [0])[-1]))
+    if 11 in f:
+        return COMMIT, None
+    if 12 in f:
+        return "list_snapshots", abci.RequestListSnapshots()
+    if 13 in f:
+        m = proto.fields(f[13][-1])
+        return "offer_snapshot", abci.RequestOfferSnapshot(
+            snapshot=_snapshot_unmarshal(m[1][-1]) if 1 in m else None,
+            app_hash=m.get(2, [b""])[-1])
+    if 14 in f:
+        m = proto.fields(f[14][-1])
+        return "load_snapshot_chunk", abci.RequestLoadSnapshotChunk(
+            height=m.get(1, [0])[-1], format=m.get(2, [0])[-1],
+            chunk=m.get(3, [0])[-1])
+    if 15 in f:
+        m = proto.fields(f[15][-1])
+        return "apply_snapshot_chunk", abci.RequestApplySnapshotChunk(
+            index=m.get(1, [0])[-1], chunk=m.get(2, [b""])[-1],
+            sender=m.get(3, [b""])[-1].decode() if 3 in m else "")
+    if 4 in f:  # set_option (deprecated in the reference, kept for parity)
+        m = proto.fields(f[4][-1])
+        return "set_option", (
+            m.get(1, [b""])[-1].decode() if 1 in m else "",
+            m.get(2, [b""])[-1].decode() if 2 in m else "")
+    raise ValueError("unknown/empty ABCI request")
+
+
+# --- response encode/decode -------------------------------------------------
+
+
+def encode_response(kind: str, resp=None, error: str | None = None) -> bytes:
+    w = proto.Writer()
+    if error is not None:
+        w.message(1, proto.Writer().string(1, error).out(), always=True)
+        return w.out()
+    if kind == ECHO:
+        w.message(2, proto.Writer().string(1, resp or "").out(), always=True)
+    elif kind == FLUSH:
+        w.message(3, b"", always=True)
+    elif kind == "info":
+        inner = (proto.Writer().string(1, resp.data).string(2, resp.version)
+                 .uvarint(3, resp.app_version).varint(4, resp.last_block_height)
+                 .bytes(5, resp.last_block_app_hash).out())
+        w.message(4, inner, always=True)
+    elif kind == "set_option":
+        inner = (proto.Writer().uvarint(1, resp.code).string(3, resp.log)
+                 .string(4, resp.info).out())
+        w.message(5, inner, always=True)
+    elif kind == "init_chain":
+        iw = proto.Writer()
+        if resp.consensus_params is not None:
+            iw.message(1, resp.consensus_params.marshal(), always=True)
+        for v in resp.validators:
+            iw.message(2, v.marshal(), always=True)
+        iw.bytes(3, resp.app_hash)
+        w.message(6, iw.out(), always=True)
+    elif kind == "query":
+        inner = (proto.Writer().uvarint(1, resp.code).string(3, resp.log)
+                 .string(4, resp.info).varint(5, resp.index).bytes(6, resp.key)
+                 .bytes(7, resp.value).varint(9, resp.height)
+                 .string(10, resp.codespace).out())
+        w.message(7, inner, always=True)
+    elif kind == "begin_block":
+        bw = proto.Writer()
+        _events_marshal(bw, 1, resp.events)
+        w.message(8, bw.out(), always=True)
+    elif kind == "check_tx":
+        cw = (proto.Writer().uvarint(1, resp.code).bytes(2, resp.data)
+              .string(3, resp.log).string(4, resp.info)
+              .varint(5, resp.gas_wanted).varint(6, resp.gas_used))
+        _events_marshal(cw, 7, resp.events)
+        cw.string(8, resp.codespace).string(9, resp.sender).varint(10, resp.priority)
+        cw.string(11, resp.mempool_error)
+        w.message(9, cw.out(), always=True)
+    elif kind == "deliver_tx":
+        w.message(10, resp.marshal(), always=True)
+    elif kind == "end_block":
+        ew = proto.Writer()
+        for v in resp.validator_updates:
+            ew.message(1, v.marshal(), always=True)
+        if resp.consensus_param_updates is not None:
+            ew.message(2, resp.consensus_param_updates.marshal(), always=True)
+        _events_marshal(ew, 3, resp.events)
+        w.message(11, ew.out(), always=True)
+    elif kind == COMMIT:
+        inner = (proto.Writer().bytes(2, resp.data)
+                 .varint(3, resp.retain_height).out())
+        w.message(12, inner, always=True)
+    elif kind == "list_snapshots":
+        lw = proto.Writer()
+        for s in resp.snapshots:
+            lw.message(1, _snapshot_marshal(s), always=True)
+        w.message(13, lw.out(), always=True)
+    elif kind == "offer_snapshot":
+        w.message(14, proto.Writer().varint(1, resp.result).out(), always=True)
+    elif kind == "load_snapshot_chunk":
+        w.message(15, proto.Writer().bytes(1, resp.chunk).out(), always=True)
+    elif kind == "apply_snapshot_chunk":
+        aw = proto.Writer().varint(1, resp.result)
+        for c in resp.refetch_chunks:
+            aw.uvarint(2, c)
+        for s in resp.reject_senders:
+            aw.string(3, s)
+        w.message(16, aw.out(), always=True)
+    else:
+        raise ValueError(f"unknown response kind {kind!r}")
+    return w.out()
+
+
+class ABCIRemoteError(Exception):
+    """Server sent ResponseException (reference: abci/client/socket_client.go
+    error handling)."""
+
+
+def decode_response(buf: bytes) -> tuple[str, object]:
+    from tendermint_tpu.types.params import ConsensusParams
+
+    f = proto.fields(buf)
+    if 1 in f:
+        msg = proto.fields(f[1][-1]).get(1, [b""])[-1].decode()
+        raise ABCIRemoteError(msg)
+    if 2 in f:
+        return ECHO, proto.fields(f[2][-1]).get(1, [b""])[-1].decode()
+    if 3 in f:
+        return FLUSH, None
+    if 4 in f:
+        m = proto.fields(f[4][-1])
+        return "info", abci.ResponseInfo(
+            data=m.get(1, [b""])[-1].decode() if 1 in m else "",
+            version=m.get(2, [b""])[-1].decode() if 2 in m else "",
+            app_version=m.get(3, [0])[-1],
+            last_block_height=proto.as_sint64(m.get(4, [0])[-1]),
+            last_block_app_hash=m.get(5, [b""])[-1])
+    if 5 in f:
+        m = proto.fields(f[5][-1])
+        return "set_option", abci.ResponseSetOption(
+            code=m.get(1, [0])[-1],
+            log=m.get(3, [b""])[-1].decode() if 3 in m else "",
+            info=m.get(4, [b""])[-1].decode() if 4 in m else "")
+    if 6 in f:
+        m = proto.fields(f[6][-1])
+        return "init_chain", abci.ResponseInitChain(
+            consensus_params=ConsensusParams.unmarshal(m[1][-1]) if 1 in m else None,
+            validators=[abci.ValidatorUpdate.unmarshal(b) for b in m.get(2, [])],
+            app_hash=m.get(3, [b""])[-1])
+    if 7 in f:
+        m = proto.fields(f[7][-1])
+        return "query", abci.ResponseQuery(
+            code=m.get(1, [0])[-1],
+            log=m.get(3, [b""])[-1].decode() if 3 in m else "",
+            info=m.get(4, [b""])[-1].decode() if 4 in m else "",
+            index=proto.as_sint64(m.get(5, [0])[-1]),
+            key=m.get(6, [b""])[-1], value=m.get(7, [b""])[-1],
+            height=proto.as_sint64(m.get(9, [0])[-1]),
+            codespace=m.get(10, [b""])[-1].decode() if 10 in m else "")
+    if 8 in f:
+        from tendermint_tpu.abci.types import Event
+
+        m = proto.fields(f[8][-1])
+        return "begin_block", abci.ResponseBeginBlock(
+            events=[Event.unmarshal(b) for b in m.get(1, [])])
+    if 9 in f:
+        from tendermint_tpu.abci.types import Event
+
+        m = proto.fields(f[9][-1])
+        return "check_tx", abci.ResponseCheckTx(
+            code=m.get(1, [0])[-1], data=m.get(2, [b""])[-1],
+            log=m.get(3, [b""])[-1].decode() if 3 in m else "",
+            info=m.get(4, [b""])[-1].decode() if 4 in m else "",
+            gas_wanted=proto.as_sint64(m.get(5, [0])[-1]),
+            gas_used=proto.as_sint64(m.get(6, [0])[-1]),
+            events=[Event.unmarshal(b) for b in m.get(7, [])],
+            codespace=m.get(8, [b""])[-1].decode() if 8 in m else "",
+            sender=m.get(9, [b""])[-1].decode() if 9 in m else "",
+            priority=proto.as_sint64(m.get(10, [0])[-1]),
+            mempool_error=m.get(11, [b""])[-1].decode() if 11 in m else "")
+    if 10 in f:
+        return "deliver_tx", abci.ResponseDeliverTx.unmarshal(f[10][-1])
+    if 11 in f:
+        from tendermint_tpu.abci.types import Event
+
+        m = proto.fields(f[11][-1])
+        return "end_block", abci.ResponseEndBlock(
+            validator_updates=[abci.ValidatorUpdate.unmarshal(b) for b in m.get(1, [])],
+            consensus_param_updates=(ConsensusParams.unmarshal(m[2][-1])
+                                     if 2 in m else None),
+            events=[Event.unmarshal(b) for b in m.get(3, [])])
+    if 12 in f:
+        m = proto.fields(f[12][-1])
+        return COMMIT, abci.ResponseCommit(
+            data=m.get(2, [b""])[-1],
+            retain_height=proto.as_sint64(m.get(3, [0])[-1]))
+    if 13 in f:
+        m = proto.fields(f[13][-1])
+        return "list_snapshots", abci.ResponseListSnapshots(
+            snapshots=[_snapshot_unmarshal(b) for b in m.get(1, [])])
+    if 14 in f:
+        return "offer_snapshot", abci.ResponseOfferSnapshot(
+            result=proto.as_sint64(proto.fields(f[14][-1]).get(1, [0])[-1]))
+    if 15 in f:
+        return "load_snapshot_chunk", abci.ResponseLoadSnapshotChunk(
+            chunk=proto.fields(f[15][-1]).get(1, [b""])[-1])
+    if 16 in f:
+        m = proto.fields(f[16][-1])
+        return "apply_snapshot_chunk", abci.ResponseApplySnapshotChunk(
+            result=proto.as_sint64(m.get(1, [0])[-1]),
+            refetch_chunks=list(m.get(2, [])),
+            reject_senders=[b.decode() for b in m.get(3, [])])
+    raise ValueError("unknown/empty ABCI response")

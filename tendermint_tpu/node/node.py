@@ -27,15 +27,18 @@ from tendermint_tpu.types.genesis import GenesisDoc
 
 
 def default_app(name: str):
-    """In-proc app selection (reference: proxy/client.go:75
-    DefaultClientCreator)."""
+    """App selection (reference: proxy/client.go:75 DefaultClientCreator):
+    a known in-proc app name, or a tcp://|unix:// address of an out-of-process
+    ABCI socket server."""
+    if name.startswith(("tcp://", "unix://")):
+        return name  # resolved to socket clients by abci.proxy.new_app_conns
     if name in ("kvstore", "persistent_kvstore"):
         return KVStoreApplication()
     if name == "noop":
         from tendermint_tpu.abci.types import Application
 
         return Application()
-    raise ValueError(f"unknown in-proc app {name!r}; socket/grpc apps not wired here")
+    raise ValueError(f"unknown proxy app {name!r}")
 
 
 class Node:
@@ -62,15 +65,19 @@ class Node:
             state = make_genesis_state(self.genesis)
             self.state_store.save(state)
 
-        # app (in-proc by default; socket ABCI via abci.server elsewhere)
+        # app: in-proc object or socket address -> 4-connection proxy
+        # (reference: node/node.go:731 createAndStartProxyAppConns)
+        from tendermint_tpu.abci.proxy import new_app_conns
+
         self.app = app if app is not None else default_app(config.base.proxy_app)
+        self.proxy_app = new_app_conns(self.app)
 
         # ABCI handshake/replay (reference: node/node.go:777 doHandshake)
         from tendermint_tpu.consensus.replay import Handshaker
 
         self.event_bus = EventBus()
         handshaker = Handshaker(self.state_store, self.block_store, self.genesis)
-        state = handshaker.handshake(state, self.app)
+        state = handshaker.handshake(state, self.proxy_app.consensus)
 
         # priv validator
         if priv_validator is None and config.base.priv_validator_key_file:
@@ -81,7 +88,7 @@ class Node:
 
         # mempool
         self.mempool = Mempool(
-            self.app,
+            self.proxy_app.mempool,
             version=config.mempool.version,
             max_txs=config.mempool.size,
             max_txs_bytes=config.mempool.max_txs_bytes,
@@ -98,7 +105,7 @@ class Node:
 
         # block executor
         self.block_exec = BlockExecutor(
-            self.state_store, self.app, mempool=self.mempool,
+            self.state_store, self.proxy_app.consensus, mempool=self.mempool,
             evidence_pool=self.evidence_pool, event_bus=self.event_bus,
             block_store=self.block_store,
         )
@@ -148,12 +155,12 @@ class Node:
         syncer = None
         if self._statesync_active:
             syncer = Syncer(
-                self.app, self._make_state_provider(),
+                self.proxy_app.snapshot, self._make_state_provider(),
                 chunk_request_timeout_s=config.statesync.chunk_request_timeout_s,
                 chunk_fetchers=config.statesync.chunk_fetchers)
         # Reactor is registered unconditionally: every node SERVES snapshots
         # from its app (reference: node.go:839 statesync.NewReactor).
-        self.statesync_reactor = StateSyncReactor(self.app, syncer)
+        self.statesync_reactor = StateSyncReactor(self.proxy_app.snapshot, syncer)
 
         self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
         self.switch.add_reactor("BLOCKCHAIN", self.bc_reactor)

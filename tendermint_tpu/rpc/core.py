@@ -393,7 +393,7 @@ def broadcast_tx_commit(env, tx):
 
 def check_tx(env, tx):
     raw = _decode_tx_param(tx)
-    res = env.node.app.check_tx(abci.RequestCheckTx(tx=raw))
+    res = env.node.proxy_app.mempool.check_tx(abci.RequestCheckTx(tx=raw))
     return {"code": res.code, "data": _b64(res.data), "log": res.log,
             "gas_wanted": str(res.gas_wanted), "gas_used": str(res.gas_used)}
 
@@ -425,7 +425,7 @@ def tx_search(env, query="", prove=False, page=1, per_page=30, order_by="asc"):
 
 def abci_query(env, path="", data="", height=0, prove=False):
     raw = bytes.fromhex(data) if isinstance(data, str) else data
-    res = env.node.app.query(abci.RequestQuery(data=raw, path=path,
+    res = env.node.proxy_app.query.query(abci.RequestQuery(data=raw, path=path,
                                                height=int(height), prove=bool(prove)))
     return {"response": {
         "code": res.code, "log": res.log, "info": res.info,
@@ -435,7 +435,7 @@ def abci_query(env, path="", data="", height=0, prove=False):
 
 
 def abci_info(env):
-    res = env.node.app.info(abci.RequestInfo())
+    res = env.node.proxy_app.query.info(abci.RequestInfo())
     return {"response": {
         "data": res.data, "version": res.version,
         "app_version": str(res.app_version),
