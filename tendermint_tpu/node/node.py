@@ -79,8 +79,20 @@ class Node:
         handshaker = Handshaker(self.state_store, self.block_store, self.genesis)
         state = handshaker.handshake(state, self.proxy_app.consensus)
 
-        # priv validator
-        if priv_validator is None and config.base.priv_validator_key_file:
+        # priv validator: remote signer socket, or local file PV
+        # (reference: node/node.go:753 createAndStartPrivValidatorSocketClient)
+        if priv_validator is None and config.base.priv_validator_laddr:
+            from tendermint_tpu.privval.signer import (
+                RetrySignerClient,
+                SignerClient,
+                SignerListenerEndpoint,
+            )
+
+            self.signer_endpoint = SignerListenerEndpoint(
+                config.base.priv_validator_laddr)
+            priv_validator = RetrySignerClient(
+                SignerClient(self.signer_endpoint, self.genesis.chain_id))
+        elif priv_validator is None and config.base.priv_validator_key_file:
             priv_validator = FilePV.load_or_generate(
                 config.priv_validator_key_file(), config.priv_validator_state_file()
             )
@@ -222,6 +234,9 @@ class Node:
             self.rpc_server.stop()
         self.consensus.stop()
         self.switch.stop()
+        if getattr(self, "signer_endpoint", None) is not None:
+            self.signer_endpoint.close()
+        self.proxy_app.stop()
 
     # --- state sync --------------------------------------------------------
 

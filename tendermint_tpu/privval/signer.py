@@ -1,0 +1,377 @@
+"""Remote signer protocol (reference: privval/signer_client.go:16,
+privval/signer_listener_endpoint.go, privval/signer_server.go,
+privval/signer_dialer_endpoint.go, proto/tendermint/privval/types.proto).
+
+Key isolation: the validator's private key lives in a separate signer
+process. The NODE listens on privval_laddr; the SIGNER dials in (so the key
+box needs no open ports), then the node sends sign requests over that
+connection:
+
+  node  SignerListenerEndpoint + SignerClient (PrivValidator impl)
+  signer SignerServer wrapping a FilePV, dials the node
+
+Message oneof (reference proto field numbers):
+  PubKeyRequest=1{chain_id=1}  PubKeyResponse=2{pub_key=1, error=2}
+  SignVoteRequest=3{vote=1, chain_id=2}  SignedVoteResponse=4{vote=1, error=2}
+  SignProposalRequest=5{proposal=1, chain_id=2}
+  SignedProposalResponse=6{proposal=1, error=2}  PingRequest=7  PingResponse=8
+RemoteSignerError{code=1, description=2}.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from tendermint_tpu.crypto import keys
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+
+
+class RemoteSignerError(Exception):
+    def __init__(self, code: int, description: str):
+        self.code = code
+        self.description = description
+        super().__init__(f"signer error (code {code}): {description}")
+
+
+# --- framing (uvarint length-delimited, like ABCI) --------------------------
+
+
+def _write_msg(wfile, msg: bytes) -> None:
+    wfile.write(proto.encode_uvarint(len(msg)) + msg)
+    wfile.flush()
+
+
+def _read_msg(rfile) -> bytes | None:
+    shift = 0
+    length = 0
+    while True:
+        b = rfile.read(1)
+        if not b:
+            return None
+        length |= (b[0] & 0x7F) << shift
+        if not b[0] & 0x80:
+            break
+        shift += 7
+        if shift > 63:
+            raise ValueError("bad length prefix")
+    if length > 1 << 20:
+        raise ValueError("privval message too large")
+    out = b""
+    while len(out) < length:
+        chunk = rfile.read(length - len(out))
+        if not chunk:
+            raise EOFError("truncated privval message")
+        out += chunk
+    return out
+
+
+# --- message codecs ---------------------------------------------------------
+
+
+def _pubkey_marshal(pub: keys.PubKey) -> bytes:
+    # crypto proto PublicKey oneof: ed25519=1, secp256k1=2
+    fieldnum = {"ed25519": 1, "secp256k1": 2}.get(pub.type, 1)
+    return proto.Writer().bytes(fieldnum, pub.bytes()).out()
+
+
+def _pubkey_unmarshal(buf: bytes) -> keys.PubKey:
+    f = proto.fields(buf)
+    if 1 in f:
+        return keys.pubkey_from_type_bytes("ed25519", f[1][-1])
+    if 2 in f:
+        return keys.pubkey_from_type_bytes("secp256k1", f[2][-1])
+    raise ValueError("empty remote-signer pubkey")
+
+
+def _error_marshal(e: RemoteSignerError) -> bytes:
+    return proto.Writer().varint(1, e.code).string(2, e.description).out()
+
+
+def _maybe_error(f: dict, fieldnum: int) -> None:
+    if fieldnum in f:
+        ef = proto.fields(f[fieldnum][-1])
+        raise RemoteSignerError(
+            proto.as_sint64(ef.get(1, [0])[-1]),
+            ef.get(2, [b""])[-1].decode() if 2 in ef else "")
+
+
+def msg_pubkey_request(chain_id: str) -> bytes:
+    inner = proto.Writer().string(1, chain_id).out()
+    return proto.Writer().message(1, inner, always=True).out()
+
+
+def msg_sign_vote_request(chain_id: str, vote: Vote) -> bytes:
+    inner = (proto.Writer().message(1, vote.marshal(), always=True)
+             .string(2, chain_id).out())
+    return proto.Writer().message(3, inner, always=True).out()
+
+
+def msg_sign_proposal_request(chain_id: str, p: Proposal) -> bytes:
+    inner = (proto.Writer().message(1, p.marshal(), always=True)
+             .string(2, chain_id).out())
+    return proto.Writer().message(5, inner, always=True).out()
+
+
+def msg_ping_request() -> bytes:
+    return proto.Writer().message(7, b"", always=True).out()
+
+
+# --- signer side ------------------------------------------------------------
+
+
+class SignerServer:
+    """Wraps a PrivValidator and serves sign requests; DIALS the node
+    (reference: privval/signer_server.go + signer_dialer_endpoint.go)."""
+
+    def __init__(self, priv_validator, addr: str,
+                 retries: int = 40, retry_interval_s: float = 0.25):
+        self.pv = priv_validator
+        self.addr = addr
+        self.retries = retries
+        self.retry_interval_s = retry_interval_s
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._sock: socket.socket | None = None
+
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._run, name="signer-server",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _dial(self) -> socket.socket | None:
+        host, port = self.addr.split("://", 1)[1].rsplit(":", 1)
+        for _ in range(self.retries):
+            if not self._running:
+                return None
+            try:
+                return socket.create_connection((host, int(port)), timeout=5.0)
+            except OSError:
+                time.sleep(self.retry_interval_s)
+        return None
+
+    def _run(self) -> None:
+        while self._running:
+            sock = self._dial()
+            if sock is None:
+                return
+            self._sock = sock
+            try:
+                self._serve(sock)
+            except (OSError, EOFError, ValueError):
+                pass
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            # connection lost: re-dial unless stopping
+
+    def _serve(self, sock: socket.socket) -> None:
+        rfile = sock.makefile("rb")
+        wfile = sock.makefile("wb")
+        while self._running:
+            buf = _read_msg(rfile)
+            if buf is None:
+                return
+            _write_msg(wfile, self._handle(buf))
+
+    def _handle(self, buf: bytes) -> bytes:
+        """reference: privval/signer_requestHandler.go DefaultValidationRequestHandler."""
+        f = proto.fields(buf)
+        w = proto.Writer()
+        if 1 in f:  # PubKeyRequest
+            pub = self.pv.get_pub_key()
+            inner = proto.Writer().message(1, _pubkey_marshal(pub), always=True).out()
+            return w.message(2, inner, always=True).out()
+        if 3 in f:  # SignVoteRequest
+            m = proto.fields(f[3][-1])
+            vote = Vote.unmarshal(m.get(1, [b""])[-1])
+            chain_id = m.get(2, [b""])[-1].decode() if 2 in m else ""
+            try:
+                self.pv.sign_vote(chain_id, vote)
+                inner = proto.Writer().message(1, vote.marshal(), always=True).out()
+            except Exception as e:  # noqa: BLE001 - double-sign guard etc.
+                inner = proto.Writer().message(
+                    2, _error_marshal(RemoteSignerError(1, str(e))), always=True).out()
+            return w.message(4, inner, always=True).out()
+        if 5 in f:  # SignProposalRequest
+            m = proto.fields(f[5][-1])
+            prop = Proposal.unmarshal(m.get(1, [b""])[-1])
+            chain_id = m.get(2, [b""])[-1].decode() if 2 in m else ""
+            try:
+                self.pv.sign_proposal(chain_id, prop)
+                inner = proto.Writer().message(1, prop.marshal(), always=True).out()
+            except Exception as e:  # noqa: BLE001
+                inner = proto.Writer().message(
+                    2, _error_marshal(RemoteSignerError(2, str(e))), always=True).out()
+            return w.message(6, inner, always=True).out()
+        if 7 in f:  # PingRequest
+            return w.message(8, b"", always=True).out()
+        # unknown request -> error response in a PubKeyResponse envelope
+        inner = proto.Writer().message(
+            2, _error_marshal(RemoteSignerError(3, "unknown request")), always=True).out()
+        return w.message(2, inner, always=True).out()
+
+
+# --- node side --------------------------------------------------------------
+
+
+class SignerListenerEndpoint:
+    """Listens for the signer's inbound connection (reference:
+    privval/signer_listener_endpoint.go)."""
+
+    def __init__(self, laddr: str, timeout_s: float = 5.0,
+                 accept_timeout_s: float = 30.0):
+        self.timeout_s = timeout_s
+        self.accept_timeout_s = accept_timeout_s
+        host, port = laddr.split("://", 1)[1].rsplit(":", 1)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(1)
+        h, p = self._listener.getsockname()[:2]
+        self.laddr = f"tcp://{h}:{p}"
+        self._conn: socket.socket | None = None
+        self._rfile = None
+        self._wfile = None
+        self._mtx = threading.Lock()
+
+    def _ensure_connection(self) -> None:
+        if self._conn is not None:
+            return
+        self._listener.settimeout(self.accept_timeout_s)
+        conn, _ = self._listener.accept()
+        conn.settimeout(self.timeout_s)
+        self._conn = conn
+        self._rfile = conn.makefile("rb")
+        self._wfile = conn.makefile("wb")
+
+    def _drop_connection(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+        self._conn = None
+
+    def send_request(self, msg: bytes) -> bytes:
+        with self._mtx:
+            self._ensure_connection()
+            try:
+                _write_msg(self._wfile, msg)
+                resp = _read_msg(self._rfile)
+            except (OSError, EOFError) as e:
+                self._drop_connection()
+                raise ConnectionError(f"remote signer connection failed: {e}") from e
+            if resp is None:
+                self._drop_connection()
+                raise ConnectionError("remote signer closed the connection")
+            return resp
+
+    def close(self) -> None:
+        with self._mtx:
+            self._drop_connection()
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+
+class SignerClient:
+    """PrivValidator over a remote signer endpoint (reference:
+    privval/signer_client.go:16)."""
+
+    def __init__(self, endpoint: SignerListenerEndpoint, chain_id: str):
+        self.endpoint = endpoint
+        self.chain_id = chain_id
+        self._cached_pub: keys.PubKey | None = None
+
+    def ping(self) -> bool:
+        try:
+            f = proto.fields(self.endpoint.send_request(msg_ping_request()))
+            return 8 in f
+        except ConnectionError:
+            return False
+
+    def get_pub_key(self) -> keys.PubKey:
+        if self._cached_pub is None:
+            f = proto.fields(self.endpoint.send_request(
+                msg_pubkey_request(self.chain_id)))
+            if 2 not in f:
+                raise RemoteSignerError(3, "unexpected response to PubKeyRequest")
+            m = proto.fields(f[2][-1])
+            _maybe_error(m, 2)
+            self._cached_pub = _pubkey_unmarshal(m.get(1, [b""])[-1])
+        return self._cached_pub
+
+    def get_address(self) -> bytes:
+        return self.get_pub_key().address()
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        f = proto.fields(self.endpoint.send_request(
+            msg_sign_vote_request(chain_id, vote)))
+        if 4 not in f:
+            raise RemoteSignerError(3, "unexpected response to SignVoteRequest")
+        m = proto.fields(f[4][-1])
+        _maybe_error(m, 2)
+        signed = Vote.unmarshal(m.get(1, [b""])[-1])
+        vote.signature = signed.signature
+        vote.timestamp = signed.timestamp
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        f = proto.fields(self.endpoint.send_request(
+            msg_sign_proposal_request(chain_id, proposal)))
+        if 6 not in f:
+            raise RemoteSignerError(3, "unexpected response to SignProposalRequest")
+        m = proto.fields(f[6][-1])
+        _maybe_error(m, 2)
+        signed = Proposal.unmarshal(m.get(1, [b""])[-1])
+        proposal.signature = signed.signature
+        proposal.timestamp = signed.timestamp
+
+
+class RetrySignerClient:
+    """Retries transient connection failures (reference:
+    privval/retry_signer_client.go). RemoteSignerError (e.g. the double-sign
+    guard) is NOT retried -- retrying a refusal would be unsafe."""
+
+    def __init__(self, client: SignerClient, retries: int = 5,
+                 interval_s: float = 0.2):
+        self.client = client
+        self.retries = retries
+        self.interval_s = interval_s
+
+    def _retry(self, fn, *args):
+        last: Exception | None = None
+        for _ in range(self.retries):
+            try:
+                return fn(*args)
+            except ConnectionError as e:
+                last = e
+                time.sleep(self.interval_s)
+        raise last
+
+    def get_pub_key(self) -> keys.PubKey:
+        return self._retry(self.client.get_pub_key)
+
+    def get_address(self) -> bytes:
+        return self._retry(self.client.get_address)
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        return self._retry(self.client.sign_vote, chain_id, vote)
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        return self._retry(self.client.sign_proposal, chain_id, proposal)

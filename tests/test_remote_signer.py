@@ -1,0 +1,148 @@
+"""Remote signer privval: protocol round-trips, double-sign refusal over the
+wire, reconnect/retry, and the harness criterion -- a validator committing
+blocks while signing over a socket (reference: privval/signer_client.go:16,
+signer_listener_endpoint.go, signer_server.go)."""
+
+import os
+import time
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.privval.file_pv import FilePV, MockPV
+from tendermint_tpu.privval.signer import (
+    RemoteSignerError,
+    RetrySignerClient,
+    SignerClient,
+    SignerListenerEndpoint,
+    SignerServer,
+)
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE, Vote
+
+CHAIN_ID = "signer-chain"
+
+
+def _bid():
+    return BlockID(hash=b"\xaa" * 32,
+                   part_set_header=PartSetHeader(total=1, hash=b"\xbb" * 32))
+
+
+def _endpoint_pair(pv):
+    ep = SignerListenerEndpoint("tcp://127.0.0.1:0", accept_timeout_s=10.0)
+    server = SignerServer(pv, ep.laddr)
+    server.start()
+    return ep, server
+
+
+def test_signer_roundtrip_and_double_sign_guard(tmp_path):
+    pv = FilePV.generate(str(tmp_path / "key.json"), str(tmp_path / "state.json"),
+                         seed=b"\x81" * 32)
+    ep, server = _endpoint_pair(pv)
+    try:
+        client = SignerClient(ep, CHAIN_ID)
+        assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
+        assert client.get_address() == pv.get_address()
+        assert client.ping()
+
+        vote = Vote(type=PREVOTE_TYPE, height=5, round=0, block_id=_bid(),
+                    timestamp=Time(1700000100, 0),
+                    validator_address=pv.get_address(), validator_index=0)
+        client.sign_vote(CHAIN_ID, vote)
+        assert vote.signature
+        vote.verify(CHAIN_ID, pv.get_pub_key())  # raises if invalid
+
+        # Same HRS with different block: the FilePV double-sign guard fires
+        # remotely and surfaces as RemoteSignerError (never silently signs).
+        conflicting = Vote(type=PREVOTE_TYPE, height=5, round=0,
+                           block_id=BlockID(hash=b"\xcc" * 32,
+                                            part_set_header=PartSetHeader(1, b"\xdd" * 32)),
+                           timestamp=Time(1700000101, 0),
+                           validator_address=pv.get_address(), validator_index=0)
+        with pytest.raises(RemoteSignerError):
+            client.sign_vote(CHAIN_ID, conflicting)
+
+        prop = Proposal(height=6, round=0, pol_round=-1, block_id=_bid(),
+                        timestamp=Time(1700000102, 0))
+        client.sign_proposal(CHAIN_ID, prop)
+        assert prop.signature
+        sb = prop.sign_bytes(CHAIN_ID)
+        assert pv.get_pub_key().verify_signature(sb, prop.signature)
+    finally:
+        server.stop()
+        ep.close()
+
+
+def test_signer_reconnect_and_retry():
+    pv = MockPV(ed25519.gen_priv_key(b"\x82" * 32))
+    ep, server = _endpoint_pair(pv)
+    try:
+        client = RetrySignerClient(SignerClient(ep, CHAIN_ID),
+                                   retries=20, interval_s=0.1)
+        assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
+        # Drop the connection out from under the client: SignerServer
+        # re-dials, RetrySignerClient re-sends.
+        ep._drop_connection()
+        vote = Vote(type=PRECOMMIT_TYPE, height=9, round=1, block_id=_bid(),
+                    timestamp=Time(1700000200, 0),
+                    validator_address=pv.get_address(), validator_index=0)
+        client.sign_vote(CHAIN_ID, vote)
+        vote.verify(CHAIN_ID, pv.get_pub_key())
+    finally:
+        server.stop()
+        ep.close()
+
+
+def test_consensus_with_remote_signer(tmp_path):
+    """The VERDICT criterion: harness passes with the validator signing over
+    a socket (reference: node/node.go:753)."""
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    signer_pv = FilePV.generate(str(tmp_path / "signer_key.json"),
+                                str(tmp_path / "signer_state.json"),
+                                seed=b"\x83" * 32)
+    genesis = GenesisDoc(
+        chain_id=CHAIN_ID, genesis_time=Time(1700003000, 0),
+        validators=[GenesisValidator(b"", signer_pv.get_pub_key(), 10)],
+    )
+    # Operators know the privval address up front: the signer starts FIRST
+    # and retries dialing until the node is listening (the node blocks on the
+    # signer connection during construction, like the reference).
+    import socket as _socket
+
+    probe = _socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    privval_addr = f"tcp://127.0.0.1:{probe.getsockname()[1]}"
+    probe.close()
+
+    cfg = test_config()
+    cfg.set_root(str(tmp_path / "node"))
+    os.makedirs(cfg.base.root_dir, exist_ok=True)
+    cfg.base.fast_sync_mode = False
+    cfg.base.priv_validator_laddr = privval_addr
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = ""
+    cfg.consensus.wal_path = ""
+
+    server = SignerServer(signer_pv, privval_addr)
+    server.start()
+    node = Node(cfg, genesis=genesis, priv_validator=None,
+                node_key=NodeKey(ed25519.gen_priv_key(b"\x84" * 32)))
+    node.start()
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and node.block_store.height < 3:
+            time.sleep(0.1)
+        assert node.block_store.height >= 3
+        # every commit signature came from the remote key
+        commit = node.block_store.load_seen_commit(2)
+        assert commit is not None
+        commit.get_vote(0).verify(CHAIN_ID, signer_pv.get_pub_key())
+    finally:
+        node.stop()
+        server.stop()
