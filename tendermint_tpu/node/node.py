@@ -180,6 +180,23 @@ class Node:
         self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
         self.switch.add_reactor("STATESYNC", self.statesync_reactor)
 
+        # PEX + addrbook (reference: node/node.go:872-889
+        # createAddrBookAndSetOnSwitch + createPEXReactorAndAddToSwitch)
+        self.addr_book = None
+        self.pex_reactor = None
+        if config.p2p.pex:
+            from tendermint_tpu.p2p.addrbook import AddrBook
+            from tendermint_tpu.p2p.pex_reactor import PexReactor
+
+            self.addr_book = AddrBook(
+                config.base.resolve(config.p2p.addr_book_file),
+                strict=config.p2p.addr_book_strict)
+            self.pex_reactor = PexReactor(
+                self.addr_book, seed_mode=config.p2p.seed_mode,
+                seeds=config.p2p.seeds.split(",") if config.p2p.seeds else [],
+                logger=logger)
+            self.switch.add_reactor("PEX", self.pex_reactor)
+
         self.rpc_server = None
         self._tx_notify_thread = None
 
@@ -193,7 +210,14 @@ class Node:
 
         crypto_batch.warmup()
         if self.config.p2p.laddr:
-            self.transport.listen(self.config.p2p.laddr)
+            la = self.transport.listen(self.config.p2p.laddr)
+            if self.addr_book is not None:
+                from tendermint_tpu.p2p.addrbook import NetAddress
+
+                hp = la.split("://", 1)[1]
+                host, port = hp.rsplit(":", 1)
+                self.addr_book.add_our_address(
+                    NetAddress(self.node_key.id(), host, int(port)))
         self.switch.start()
         if self.config.p2p.persistent_peers:
             self.switch.add_persistent_peers(

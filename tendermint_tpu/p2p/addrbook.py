@@ -1,0 +1,333 @@
+"""Bucketed peer address book (reference: p2p/pex/addrbook.go).
+
+Addresses live in NEW buckets (heard about, never connected) until
+mark_good() promotes them to OLD buckets (had a successful connection).
+Bucket placement is keyed by a per-book random key hashed with the
+address group and (for new addresses) the source's group, which caps how
+much of the book a single /16 of sybils can occupy — the same eclipse
+defence as the reference (addrbook.go:118 design notes).
+
+Persistence is JSON, loaded at start and saved on a dirty flag.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import ipaddress
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+NEW_BUCKET_COUNT = 256
+OLD_BUCKET_COUNT = 64
+NEW_BUCKETS_PER_ADDRESS = 4
+OLD_BUCKETS_PER_ADDRESS = 2  # reference allows 1; kept 1 effectively below
+MAX_NEW_BUCKET_SIZE = 64
+MAX_OLD_BUCKET_SIZE = 64
+GET_SELECTION_PERCENT = 23  # reference: addrbook.go getSelection
+MAX_GET_SELECTION = 250
+BIASED_NEW_PCT_DEFAULT = 30
+
+
+@dataclass
+class NetAddress:
+    """id@host:port (reference: p2p/netaddress.go)."""
+
+    node_id: str
+    host: str
+    port: int
+
+    @staticmethod
+    def parse(s: str) -> "NetAddress":
+        if "@" not in s:
+            raise ValueError(f"address {s!r} missing node id")
+        nid, hp = s.split("@", 1)
+        if "://" in hp:
+            hp = hp.split("://", 1)[1]
+        host, port = hp.rsplit(":", 1)
+        return NetAddress(nid.lower(), host, int(port))
+
+    def __str__(self) -> str:
+        return f"{self.node_id}@{self.host}:{self.port}"
+
+    def dial_string(self) -> str:
+        return f"{self.node_id}@{self.host}:{self.port}"
+
+    def is_routable(self) -> bool:
+        """reference: netaddress.go Routable; loopback/private fail strict
+        mode."""
+        try:
+            ip = ipaddress.ip_address(self.host)
+        except ValueError:
+            return True  # hostname: assume routable
+        return not (ip.is_loopback or ip.is_private or ip.is_multicast
+                    or ip.is_unspecified)
+
+    def group(self) -> str:
+        """Eclipse-resistance group: /16 for IPv4 (reference:
+        addrbook.go groupKey)."""
+        try:
+            ip = ipaddress.ip_address(self.host)
+        except ValueError:
+            return self.host
+        if ip.version == 4:
+            parts = self.host.split(".")
+            return ".".join(parts[:2])
+        return str(ipaddress.ip_network(f"{self.host}/32", strict=False))
+
+
+@dataclass
+class _KnownAddress:
+    """reference: p2p/pex/known_address.go."""
+
+    addr: NetAddress
+    src: NetAddress
+    attempts: int = 0
+    last_attempt: float = 0.0
+    last_success: float = 0.0
+    bucket_type: str = "new"
+    buckets: list[int] = field(default_factory=list)
+
+    def is_old(self) -> bool:
+        return self.bucket_type == "old"
+
+    def to_json(self) -> dict:
+        return {
+            "addr": str(self.addr), "src": str(self.src),
+            "attempts": self.attempts, "last_attempt": self.last_attempt,
+            "last_success": self.last_success, "bucket_type": self.bucket_type,
+            "buckets": self.buckets,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "_KnownAddress":
+        return _KnownAddress(
+            addr=NetAddress.parse(d["addr"]), src=NetAddress.parse(d["src"]),
+            attempts=d.get("attempts", 0),
+            last_attempt=d.get("last_attempt", 0.0),
+            last_success=d.get("last_success", 0.0),
+            bucket_type=d.get("bucket_type", "new"),
+            buckets=list(d.get("buckets", [])),
+        )
+
+
+class AddrBook:
+    """reference: p2p/pex/addrbook.go:120 newAddrBook."""
+
+    def __init__(self, file_path: str = "", strict: bool = True):
+        self.file_path = file_path
+        self.strict = strict
+        self._mtx = threading.RLock()
+        self._addrs: dict[str, _KnownAddress] = {}  # node_id -> ka
+        self._new_buckets: list[set[str]] = [set() for _ in range(NEW_BUCKET_COUNT)]
+        self._old_buckets: list[set[str]] = [set() for _ in range(OLD_BUCKET_COUNT)]
+        self._our_ids: set[str] = set()
+        self._key = os.urandom(24).hex()
+        self._rand = random.Random()
+        self._dirty = False
+        if file_path and os.path.exists(file_path):
+            self._load()
+
+    # --- identity ----------------------------------------------------------
+
+    def add_our_address(self, addr: NetAddress) -> None:
+        with self._mtx:
+            self._our_ids.add(addr.node_id)
+
+    def our_address(self, addr: NetAddress) -> bool:
+        with self._mtx:
+            return addr.node_id in self._our_ids
+
+    # --- adding ------------------------------------------------------------
+
+    def add_address(self, addr: NetAddress, src: NetAddress) -> bool:
+        """reference: addrbook.go:196 AddAddress. Returns True if added."""
+        with self._mtx:
+            return self._add_address(addr, src)
+
+    def _add_address(self, addr: NetAddress, src: NetAddress) -> bool:
+        if addr.node_id in self._our_ids:
+            return False
+        if self.strict and not addr.is_routable():
+            return False
+        ka = self._addrs.get(addr.node_id)
+        if ka is not None:
+            if ka.is_old():
+                return False  # never demote old entries via gossip
+            # Already known: small chance to add another new bucket ref
+            # (reference: addrbook.go:560).
+            if len(ka.buckets) >= NEW_BUCKETS_PER_ADDRESS:
+                return False
+            factor = 1 << (2 * len(ka.buckets))
+            if self._rand.randrange(factor) != 0:
+                return False
+        else:
+            ka = _KnownAddress(addr=addr, src=src)
+            self._addrs[addr.node_id] = ka
+        bucket = self._calc_new_bucket(addr, src)
+        self._add_to_new_bucket(ka, bucket)
+        self._dirty = True
+        return True
+
+    def _add_to_new_bucket(self, ka: _KnownAddress, bucket: int) -> None:
+        if bucket in ka.buckets:
+            return
+        b = self._new_buckets[bucket]
+        if len(b) >= MAX_NEW_BUCKET_SIZE:
+            self._expire_new_bucket(bucket)
+        b.add(ka.addr.node_id)
+        ka.buckets.append(bucket)
+
+    def _expire_new_bucket(self, bucket: int) -> None:
+        """Evict the worst entry (most attempts, oldest success) (reference:
+        addrbook.go:666 expireNew -> pickOldest)."""
+        b = self._new_buckets[bucket]
+        if not b:
+            return
+        worst = max(
+            b, key=lambda nid: (self._addrs[nid].attempts,
+                                -self._addrs[nid].last_success))
+        self._remove_from_bucket(self._addrs[worst], bucket, "new")
+
+    def _remove_from_bucket(self, ka: _KnownAddress, bucket: int, btype: str) -> None:
+        (self._new_buckets if btype == "new" else self._old_buckets)[bucket].discard(
+            ka.addr.node_id)
+        if bucket in ka.buckets:
+            ka.buckets.remove(bucket)
+        if not ka.buckets:
+            self._addrs.pop(ka.addr.node_id, None)
+
+    # --- connection feedback ------------------------------------------------
+
+    def mark_attempt(self, addr: NetAddress) -> None:
+        with self._mtx:
+            ka = self._addrs.get(addr.node_id)
+            if ka is not None:
+                ka.attempts += 1
+                ka.last_attempt = time.time()
+                self._dirty = True
+
+    def mark_good(self, node_id: str) -> None:
+        """Successful connection: promote to an old bucket (reference:
+        addrbook.go:250 MarkGood -> moveToOld)."""
+        with self._mtx:
+            ka = self._addrs.get(node_id)
+            if ka is None:
+                return
+            ka.attempts = 0
+            ka.last_success = time.time()
+            self._dirty = True
+            if ka.is_old():
+                return
+            for b in list(ka.buckets):
+                self._new_buckets[b].discard(node_id)
+            ka.buckets.clear()
+            ka.bucket_type = "old"
+            bucket = self._calc_old_bucket(ka.addr)
+            ob = self._old_buckets[bucket]
+            if len(ob) >= MAX_OLD_BUCKET_SIZE:
+                # evict oldest-success back to new (reference moveToOld
+                # displacement)
+                loser_id = min(ob, key=lambda nid: self._addrs[nid].last_success)
+                loser = self._addrs[loser_id]
+                ob.discard(loser_id)
+                loser.bucket_type = "new"
+                loser.buckets.clear()
+                self._add_to_new_bucket(loser, self._calc_new_bucket(loser.addr, loser.src))
+            ob.add(node_id)
+            ka.buckets = [bucket]
+
+    def mark_bad(self, node_id: str) -> None:
+        with self._mtx:
+            ka = self._addrs.get(node_id)
+            if ka is None:
+                return
+            for b in list(ka.buckets):
+                self._remove_from_bucket(ka, b, ka.bucket_type)
+            self._dirty = True
+
+    def remove_address(self, addr: NetAddress) -> None:
+        self.mark_bad(addr.node_id)
+
+    # --- selection ----------------------------------------------------------
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._addrs)
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
+
+    def has_address(self, addr: NetAddress) -> bool:
+        with self._mtx:
+            return addr.node_id in self._addrs
+
+    def pick_address(self, new_bias_pct: int = BIASED_NEW_PCT_DEFAULT) -> NetAddress | None:
+        """Random address biased toward new entries (reference:
+        addrbook.go:280 PickAddress)."""
+        with self._mtx:
+            if not self._addrs:
+                return None
+            new = [ka for ka in self._addrs.values() if not ka.is_old()]
+            old = [ka for ka in self._addrs.values() if ka.is_old()]
+            pct = max(0, min(100, new_bias_pct))
+            pool = new if (self._rand.randrange(100) < pct or not old) else old
+            if not pool:
+                pool = new or old
+            return self._rand.choice(pool).addr if pool else None
+
+    def get_selection(self) -> list[NetAddress]:
+        """Random ~23% (max 250) for PEX responses (reference:
+        addrbook.go:327 GetSelection)."""
+        with self._mtx:
+            all_addrs = [ka.addr for ka in self._addrs.values()]
+        n = max(min(len(all_addrs), MAX_GET_SELECTION),
+                len(all_addrs) * GET_SELECTION_PERCENT // 100)
+        self._rand.shuffle(all_addrs)
+        return all_addrs[:n]
+
+    # --- bucket hashing (reference: addrbook.go:840-900) --------------------
+
+    def _hash(self, *parts: str) -> int:
+        h = hashlib.sha256(("|".join((self._key,) + parts)).encode()).digest()
+        return int.from_bytes(h[:8], "big")
+
+    def _calc_new_bucket(self, addr: NetAddress, src: NetAddress) -> int:
+        return self._hash("new", addr.group(), src.group()) % NEW_BUCKET_COUNT
+
+    def _calc_old_bucket(self, addr: NetAddress) -> int:
+        return self._hash("old", addr.group()) % OLD_BUCKET_COUNT
+
+    # --- persistence (reference: p2p/pex/file.go) ---------------------------
+
+    def save(self) -> None:
+        with self._mtx:
+            if not self.file_path:
+                return
+            doc = {"key": self._key,
+                   "addrs": [ka.to_json() for ka in self._addrs.values()]}
+            tmp = self.file_path + ".tmp"
+            os.makedirs(os.path.dirname(self.file_path) or ".", exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.file_path)
+            self._dirty = False
+
+    def _load(self) -> None:
+        with open(self.file_path) as f:
+            doc = json.load(f)
+        self._key = doc.get("key", self._key)
+        for d in doc.get("addrs", []):
+            try:
+                ka = _KnownAddress.from_json(d)
+            except (KeyError, ValueError):
+                continue
+            self._addrs[ka.addr.node_id] = ka
+            for b in ka.buckets:
+                if ka.is_old() and b < OLD_BUCKET_COUNT:
+                    self._old_buckets[b].add(ka.addr.node_id)
+                elif not ka.is_old() and b < NEW_BUCKET_COUNT:
+                    self._new_buckets[b].add(ka.addr.node_id)

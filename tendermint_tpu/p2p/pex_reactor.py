@@ -1,0 +1,224 @@
+"""PEX reactor: peer exchange over channel 0x00 (reference:
+p2p/pex/pex_reactor.go; proto/tendermint/p2p/pex.proto).
+
+Messages: PexRequest=1{}, PexAddrs=2{addrs=1 repeated
+PexAddress{id=1,ip=2,port=3}}.
+
+Discovery loop: learn addresses from peers, persist them in the AddrBook,
+and keep dialing book addresses until the outbound slots are full. Seed
+mode answers one address request and hangs up, serving purely as a
+bootstrap directory (reference: pex_reactor.go:396 seed crawler).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.p2p.addrbook import AddrBook, NetAddress
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Peer, Reactor
+
+PEX_CHANNEL = 0x00
+
+# reference: pex_reactor.go:33-45
+ENSURE_PEERS_INTERVAL_S = 1.0  # reference 30s; fast mesh healing for tests
+REQUEST_INTERVAL_S = 2.0  # min interval between requests we ACCEPT per peer
+SEED_DISCONNECT_DELAY_S = 2.0
+
+
+def msg_pex_request() -> bytes:
+    return proto.Writer().message(1, b"", always=True).out()
+
+
+def msg_pex_addrs(addrs: list[NetAddress]) -> bytes:
+    w = proto.Writer()
+    inner = proto.Writer()
+    for a in addrs:
+        inner.message(1, proto.Writer().string(1, a.node_id).string(2, a.host)
+                      .uvarint(3, a.port).out(), always=True)
+    w.message(2, inner.out(), always=True)
+    return w.out()
+
+
+def _parse_addrs(buf: bytes) -> list[NetAddress]:
+    out = []
+    for ab in proto.fields(buf).get(1, []):
+        f = proto.fields(ab)
+        try:
+            out.append(NetAddress(
+                node_id=f.get(1, [b""])[-1].decode().lower(),
+                host=f.get(2, [b""])[-1].decode(),
+                port=f.get(3, [0])[-1]))
+        except (UnicodeDecodeError, ValueError):
+            continue
+    return out
+
+
+class PexReactor(Reactor):
+    """reference: p2p/pex/pex_reactor.go:55."""
+
+    def __init__(self, book: AddrBook, seed_mode: bool = False,
+                 seeds: list[str] | None = None, logger=None):
+        super().__init__("PEX")
+        self.book = book
+        self.seed_mode = seed_mode
+        self.seeds = [s for s in (seeds or []) if s]
+        self.logger = logger
+        self._last_request_from: dict[str, float] = {}  # inbound rate limit
+        self._requested: set[str] = set()  # peers we asked for addrs
+        self._mtx = threading.Lock()
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._dialing: set[str] = set()
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(PEX_CHANNEL, priority=1,
+                                  recv_message_capacity=64 * 1024)]
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def on_start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._ensure_peers_routine,
+                                        name="pex-ensure", daemon=True)
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        self._running = False
+        self.book.save()
+
+    # --- peer lifecycle -----------------------------------------------------
+
+    def _peer_net_address(self, peer: Peer) -> NetAddress | None:
+        la = peer.node_info.listen_addr
+        if not la:
+            return None
+        try:
+            hp = la.split("://", 1)[1] if "://" in la else la
+            host, port = hp.rsplit(":", 1)
+            if host in ("0.0.0.0", "::"):
+                # substitute the socket's remote host
+                host = peer.socket_addr.rsplit(":", 1)[0].split("@")[-1]
+            return NetAddress(peer.id, host, int(port))
+        except (ValueError, IndexError):
+            return None
+
+    def add_peer(self, peer: Peer) -> None:
+        """reference: pex_reactor.go:130 AddPeer."""
+        na = self._peer_net_address(peer)
+        if peer.outbound:
+            # We dialed them: the address works.
+            if na is not None:
+                self.book.add_address(na, na)
+            self.book.mark_good(peer.id)
+            if not self.seed_mode:
+                self._request_addrs(peer)
+        else:
+            # Inbound: record the self-reported listen addr.
+            if na is not None:
+                self.book.add_address(na, na)
+            if self.seed_mode:
+                # Serve a selection then hang up shortly (reference seed flow).
+                peer.try_send(PEX_CHANNEL, msg_pex_addrs(self.book.get_selection()))
+
+                def later_drop():
+                    time.sleep(SEED_DISCONNECT_DELAY_S)
+                    if self.switch is not None and peer.id in self.switch.peers:
+                        self.switch.stop_peer_for_error(peer, "seed served addrs")
+
+                threading.Thread(target=later_drop, daemon=True).start()
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        with self._mtx:
+            self._requested.discard(peer.id)
+            self._last_request_from.pop(peer.id, None)
+
+    # --- receive ------------------------------------------------------------
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        f = proto.fields(msg_bytes)
+        if 1 in f:  # PexRequest
+            now = time.monotonic()
+            with self._mtx:
+                last = self._last_request_from.get(peer.id, 0.0)
+                if now - last < REQUEST_INTERVAL_S and not self.seed_mode:
+                    return  # rate-limited (reference: receiveRequest flood guard)
+                self._last_request_from[peer.id] = now
+            peer.try_send(PEX_CHANNEL, msg_pex_addrs(self.book.get_selection()))
+        elif 2 in f:  # PexAddrs
+            with self._mtx:
+                if peer.id not in self._requested and not peer.outbound:
+                    # unsolicited addrs from an inbound peer: ignore
+                    # (reference: ReceiveAddrs ErrUnsolicitedList)
+                    return
+                self._requested.discard(peer.id)
+            src = self._peer_net_address(peer) or NetAddress(peer.id, "0.0.0.0", 0)
+            for na in _parse_addrs(f[2][-1]):
+                self.book.add_address(na, src)
+
+    def _request_addrs(self, peer: Peer) -> None:
+        with self._mtx:
+            self._requested.add(peer.id)
+        peer.try_send(PEX_CHANNEL, msg_pex_request())
+
+    # --- discovery loop (reference: pex_reactor.go:270 ensurePeersRoutine) --
+
+    def _ensure_peers_routine(self) -> None:
+        # Bootstrap from configured seeds when the book is empty.
+        while self._running:
+            try:
+                self._ensure_peers()
+            except Exception:  # noqa: BLE001 - discovery must never die
+                pass
+            time.sleep(ENSURE_PEERS_INTERVAL_S)
+
+    def _ensure_peers(self) -> None:
+        sw = self.switch
+        if sw is None:
+            return
+        out, inbound = sw.num_peers()
+        need = sw.max_outbound - out
+        if need <= 0:
+            return
+        if self.book.is_empty() and self.seeds:
+            for s in self.seeds:
+                try:
+                    na = NetAddress.parse(s)
+                except ValueError:
+                    continue
+                if na.node_id not in sw.peers:
+                    self._dial(na)
+            return
+        tried = 0
+        while need > 0 and tried < 10:
+            tried += 1
+            na = self.book.pick_address()
+            if na is None:
+                break
+            if (na.node_id in sw.peers or self.book.our_address(na)
+                    or na.node_id in self._dialing):
+                continue
+            if self._dial(na):
+                need -= 1
+        # Still starving: ask a random connected peer for more addresses.
+        if need > 0:
+            with sw._peers_mtx:
+                peers = list(sw.peers.values())
+            if peers:
+                import random
+
+                self._request_addrs(random.choice(peers))
+
+    def _dial(self, na: NetAddress) -> bool:
+        self._dialing.add(na.node_id)
+        try:
+            self.book.mark_attempt(na)
+            peer = self.switch.dial_peer(na.dial_string())
+            if peer is not None:
+                self.book.mark_good(na.node_id)
+                return True
+            return False
+        finally:
+            self._dialing.discard(na.node_id)
