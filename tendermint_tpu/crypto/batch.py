@@ -84,10 +84,18 @@ class Ed25519BatchVerifier(BatchVerifier):
 
             out = [ed25519.verify(p, m, s) for (p, m, s) in items]
             return all(out), out
-        from tendermint_tpu.ops import ed25519_batch
+        import time as _t
 
+        from tendermint_tpu.ops import ed25519_batch
+        from tendermint_tpu.utils import metrics as tmmetrics
+
+        started = _t.monotonic()
         bitmap = ed25519_batch.verify_batch(items)
         out = [bool(b) for b in bitmap]
+        if tmmetrics.GLOBAL_NODE_METRICS is not None:
+            m = tmmetrics.GLOBAL_NODE_METRICS
+            m.batch_verify_seconds.observe(_t.monotonic() - started)
+            m.batch_verify_sigs.add(len(items))
         return all(out), out
 
     def __len__(self) -> int:

@@ -48,6 +48,13 @@ class Node:
                  priv_validator=None, node_key: NodeKey | None = None,
                  logger=None):
         self.config = config
+        if logger is None:
+            # real structured logger by default (reference: libs/log); tests
+            # pass NopLogger or capture stderr
+            from tendermint_tpu.utils.log import new_logger
+
+            logger = new_logger(level=config.base.log_level,
+                                fmt=config.base.log_format)
         self.logger = logger
 
         # DBs (reference: node/node.go:716,235 initDBs)
@@ -180,6 +187,35 @@ class Node:
         self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
         self.switch.add_reactor("STATESYNC", self.statesync_reactor)
 
+        # tx/block indexer (reference: node/node.go:269-315 createAndStart
+        # IndexerService)
+        self.tx_indexer = None
+        self.block_indexer = None
+        self.indexer_service = None
+        if config.tx_index.indexer == "kv":
+            from tendermint_tpu.state.txindex import (
+                BlockIndexer,
+                IndexerService,
+                TxIndexer,
+            )
+
+            idx_db = new_db(backend, os.path.join(dbdir, "tx_index.db")
+                            if backend != "memdb" else None)
+            self.tx_indexer = TxIndexer(idx_db)
+            self.block_indexer = BlockIndexer(idx_db)
+            self.indexer_service = IndexerService(
+                self.tx_indexer, self.block_indexer, self.event_bus, logger)
+
+        # Prometheus metrics (reference: node/node.go:118-132 MetricsProvider)
+        self.metrics = None
+        self.metrics_server = None
+        if config.instrumentation.prometheus:
+            from tendermint_tpu.utils import metrics as tmmetrics
+
+            self.metrics = tmmetrics.NodeMetrics(
+                tmmetrics.Registry(config.instrumentation.namespace))
+            tmmetrics.GLOBAL_NODE_METRICS = self.metrics
+
         # PEX + addrbook (reference: node/node.go:872-889
         # createAddrBookAndSetOnSwitch + createPEXReactorAndAddToSwitch)
         self.addr_book = None
@@ -251,16 +287,66 @@ class Node:
 
             self.rpc_server = RPCServer(self)
             self.rpc_server.start(self.config.rpc.laddr)
+        # indexer + Prometheus (reference: node/node.go:964,1219)
+        if self.indexer_service is not None:
+            self.indexer_service.start()
+        if self.metrics is not None:
+            from tendermint_tpu.utils.metrics import MetricsServer
+
+            self.metrics_server = MetricsServer(
+                self.metrics.registry,
+                self.config.instrumentation.prometheus_listen_addr)
+            self.metrics_server.start()
+            self._metrics_thread = __import__("threading").Thread(
+                target=self._metrics_sampler, name="metrics-sampler", daemon=True)
+            self._metrics_thread.start()
 
     def stop(self) -> None:
         self._running = False
         if self.rpc_server is not None:
             self.rpc_server.stop()
+        if self.indexer_service is not None:
+            self.indexer_service.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
         self.consensus.stop()
         self.switch.stop()
         if getattr(self, "signer_endpoint", None) is not None:
             self.signer_endpoint.close()
         self.proxy_app.stop()
+
+    def _metrics_sampler(self) -> None:
+        """Gauge sampling loop; histograms are fed at their call sites
+        (reference wires metrics structs through constructors -- a sampler
+        keeps the hot paths free of metric plumbing)."""
+        import time as _t
+
+        m = self.metrics
+        last_height = self.block_store.height
+        last_height_t = _t.monotonic()
+        while self._running:
+            try:
+                h = self.block_store.height
+                m.height.set(h)
+                if h > last_height:
+                    now = _t.monotonic()
+                    m.block_interval_seconds.observe((now - last_height_t) / max(h - last_height, 1))
+                    meta = self.block_store.load_block_meta(h)
+                    if meta is not None:
+                        m.num_txs.set(meta.num_txs)
+                        m.total_txs.add(meta.num_txs)
+                        m.block_size_bytes.set(meta.block_size)
+                    last_height, last_height_t = h, now
+                st = self.state_store.load()
+                if st.validators is not None:
+                    m.validators.set(st.validators.size())
+                    m.validators_power.set(st.validators.total_voting_power())
+                m.mempool_size.set(self.mempool.size())
+                m.peers.set(len(self.switch.peers))
+                m.rounds.set(getattr(self.consensus.rs, "round", 0))
+            except Exception:  # noqa: BLE001 - sampling must never kill a node
+                pass
+            _t.sleep(0.25)
 
     # --- state sync --------------------------------------------------------
 

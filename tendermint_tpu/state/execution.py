@@ -86,6 +86,11 @@ class BlockExecutor:
     # --- applying a decided block (reference: state/execution.go:131-209) --
 
     def apply_block(self, state: State, block_id: BlockID, block: Block) -> tuple[State, int]:
+        import time as _t
+
+        from tendermint_tpu.utils import metrics as tmmetrics
+
+        _started = _t.monotonic()
         self.validate_block(state, block)
 
         abci_responses = self._exec_block_on_app(state, block)
@@ -107,6 +112,9 @@ class BlockExecutor:
         self.store.save(new_state)
 
         self._fire_events(block, block_id, abci_responses, validator_updates)
+        if tmmetrics.GLOBAL_NODE_METRICS is not None:
+            tmmetrics.GLOBAL_NODE_METRICS.block_processing_time.observe(
+                _t.monotonic() - _started)
         return new_state, retain_height
 
     def _exec_block_on_app(self, state: State, block: Block) -> ABCIResponses:

@@ -1,0 +1,207 @@
+"""Tx + block indexers and the service that feeds them from the EventBus
+(reference: state/txindex/kv/kv.go, state/indexer/block/kv/kv.go,
+state/txindex/indexer_service.go:19).
+
+Index layout (kv backend):
+  txr/<hash>                -> JSON TxResult document (served raw over RPC)
+  txe/<key>/<value>/<h>/<i> -> hash  (event postings, incl. tx.height)
+  blk/<key>/<value>/<h>     -> height (block events from Begin/EndBlock)
+
+Search is the AND of per-condition posting scans, preserving the reference's
+query semantics for the `key=value` subset of the query language.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+
+from tendermint_tpu.store.db import DB, prefix_end
+from tendermint_tpu.types import events as tmevents
+from tendermint_tpu.types.tx import tx_hash
+
+
+def _esc(s: str) -> str:
+    return s.replace("/", "%2F")
+
+
+class TxIndexer:
+    """reference: state/txindex/kv/kv.go:32 TxIndex."""
+
+    def __init__(self, db: DB):
+        self._db = db
+        self._mtx = threading.Lock()
+
+    def index(self, height: int, idx: int, tx: bytes, result) -> None:
+        h = tx_hash(tx)
+        doc = {
+            "hash": h.hex().upper(),
+            "height": str(height),
+            "index": idx,
+            "tx": base64.b64encode(tx).decode(),
+            "tx_result": {
+                "code": result.code if result else 0,
+                "data": base64.b64encode(result.data if result else b"").decode(),
+                "log": result.log if result else "",
+                "gas_wanted": str(result.gas_wanted if result else 0),
+                "gas_used": str(result.gas_used if result else 0),
+                "events": [
+                    {"type": e.type, "attributes": [
+                        {"key": base64.b64encode(a.key).decode(),
+                         "value": base64.b64encode(a.value).decode(),
+                         "index": a.index}
+                        for a in e.attributes]}
+                    for e in (result.events if result else [])
+                ],
+            },
+        }
+        sets = [(b"txr/" + h, json.dumps(doc).encode())]
+        postings = [("tx.height", str(height))]
+        for e in (result.events if result else []):
+            for a in e.attributes:
+                if not a.index:
+                    continue  # only attributes the app marked indexable
+                try:
+                    postings.append((f"{e.type}.{a.key.decode()}", a.value.decode()))
+                except UnicodeDecodeError:
+                    continue
+        for key, value in postings:
+            pk = f"txe/{_esc(key)}/{_esc(value)}/{height}/{idx}".encode()
+            sets.append((pk, h))
+        with self._mtx:
+            self._db.write_batch(sets)
+
+    def get(self, h: bytes) -> dict | None:
+        raw = self._db.get(b"txr/" + h)
+        return json.loads(raw) if raw is not None else None
+
+    def search(self, query: str) -> list[dict]:
+        """AND of key=value conditions (reference: kv.go:133 Search)."""
+        q = tmevents.Query(query)
+        conditions = [(k, v) for k, v in q.conditions if v is not None
+                      and k != tmevents.EVENT_TYPE_KEY]
+        if not conditions:
+            return []
+        result_hashes: set[bytes] | None = None
+        for key, value in conditions:
+            prefix = f"txe/{_esc(key)}/{_esc(value)}/".encode()
+            found = {v for _, v in self._db.iterator(prefix, prefix_end(prefix))}
+            result_hashes = found if result_hashes is None else (result_hashes & found)
+            if not result_hashes:
+                return []
+        docs = [self.get(h) for h in result_hashes]
+        docs = [d for d in docs if d is not None]
+        docs.sort(key=lambda d: (int(d["height"]), d["index"]))
+        return docs
+
+
+class BlockIndexer:
+    """reference: state/indexer/block/kv/kv.go."""
+
+    def __init__(self, db: DB):
+        self._db = db
+        self._mtx = threading.Lock()
+
+    def index(self, height: int, begin_block_events, end_block_events) -> None:
+        sets = [(f"blkh/{height}".encode(), str(height).encode())]
+        for stage, evs in (("begin_block", begin_block_events),
+                           ("end_block", end_block_events)):
+            for e in evs or []:
+                for a in e.attributes:
+                    if not a.index:
+                        continue
+                    try:
+                        key = f"{e.type}.{a.key.decode()}"
+                        value = a.value.decode()
+                    except UnicodeDecodeError:
+                        continue
+                    pk = f"blk/{_esc(key)}/{_esc(value)}/{height}".encode()
+                    sets.append((pk, str(height).encode()))
+        with self._mtx:
+            self._db.write_batch(sets)
+
+    def has(self, height: int) -> bool:
+        return self._db.get(f"blkh/{height}".encode()) is not None
+
+    def search(self, query: str) -> list[int]:
+        q = tmevents.Query(query)
+        conditions = [(k, v) for k, v in q.conditions if v is not None
+                      and k != tmevents.EVENT_TYPE_KEY]
+        if not conditions:
+            return []
+        heights: set[int] | None = None
+        for key, value in conditions:
+            if key == "block.height":
+                found = {int(value)} if self.has(int(value)) else set()
+            else:
+                prefix = f"blk/{_esc(key)}/{_esc(value)}/".encode()
+                found = {int(v) for _, v in self._db.iterator(prefix, prefix_end(prefix))}
+            heights = found if heights is None else (heights & found)
+            if not heights:
+                return []
+        return sorted(heights)
+
+
+class IndexerService:
+    """Subscribes to the EventBus and feeds both indexers (reference:
+    state/txindex/indexer_service.go:19)."""
+
+    SUBSCRIBER = "IndexerService"
+
+    def __init__(self, tx_indexer: TxIndexer, block_indexer: BlockIndexer,
+                 event_bus, logger=None):
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self.event_bus = event_bus
+        self.logger = logger
+        self._running = False
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._tx_sub = self.event_bus.subscribe(
+            self.SUBSCRIBER, f"{tmevents.EVENT_TYPE_KEY}={tmevents.EVENT_TX}",
+            out_capacity=0)
+        self._block_sub = self.event_bus.subscribe(
+            self.SUBSCRIBER,
+            f"{tmevents.EVENT_TYPE_KEY}={tmevents.EVENT_NEW_BLOCK_HEADER}",
+            out_capacity=0)
+        self._running = True
+        self._thread = threading.Thread(target=self._run, name="indexer",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self.event_bus.unsubscribe_all(self.SUBSCRIBER)
+        except ValueError:
+            pass
+
+    def _run(self) -> None:
+        try:
+            self._drain()
+        except tmevents.SubscriptionCancelled:
+            return  # unsubscribed during stop()
+
+    def _drain(self) -> None:
+        while self._running:
+            msg = self._tx_sub.next(timeout=0.1)
+            if msg is not None:
+                d = msg.data
+                try:
+                    self.tx_indexer.index(d.height, d.index, d.tx, d.result)
+                except Exception as e:  # noqa: BLE001
+                    if self.logger:
+                        self.logger.error("failed to index tx", err=e)
+            bmsg = self._block_sub.next(timeout=0.05)
+            if bmsg is not None:
+                d = bmsg.data
+                try:
+                    self.block_indexer.index(
+                        d.header.height,
+                        d.result_begin_block.events if d.result_begin_block else [],
+                        d.result_end_block.events if d.result_end_block else [])
+                except Exception as e:  # noqa: BLE001
+                    if self.logger:
+                        self.logger.error("failed to index block", err=e)

@@ -1,0 +1,88 @@
+"""Structured key-value logger (reference: libs/log — go-kit style).
+
+Every subsystem takes a `logger=` parameter; this is the implementation
+behind it. Supports plain ("terminal") and JSON formats, level filtering,
+and contextual binding via `with_(module=...)` exactly like the reference's
+`logger.With("module", "consensus")`.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+DEBUG, INFO, ERROR = 10, 20, 40
+_LEVELS = {"debug": DEBUG, "info": INFO, "error": ERROR}
+_NAMES = {DEBUG: "DBG", INFO: "INF", ERROR: "ERR"}
+
+
+class Logger:
+    """reference: libs/log/logger.go Logger interface."""
+
+    def __init__(self, sink=None, level: str = "info", fmt: str = "plain",
+                 _bound: dict | None = None, _lock=None):
+        self._sink = sink if sink is not None else sys.stderr
+        self._level = _LEVELS.get(level, INFO)
+        self._fmt = fmt
+        self._bound = dict(_bound or {})
+        self._lock = _lock if _lock is not None else threading.Lock()
+
+    def with_(self, **kv) -> "Logger":
+        merged = {**self._bound, **kv}
+        lg = Logger(self._sink, fmt=self._fmt, _bound=merged, _lock=self._lock)
+        lg._level = self._level
+        return lg
+
+    def debug(self, msg: str, **kv) -> None:
+        self._log(DEBUG, msg, kv)
+
+    def info(self, msg: str, **kv) -> None:
+        self._log(INFO, msg, kv)
+
+    def error(self, msg: str, **kv) -> None:
+        self._log(ERROR, msg, kv)
+
+    def _log(self, level: int, msg: str, kv: dict) -> None:
+        if level < self._level:
+            return
+        record = {**self._bound, **kv}
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime())
+        if self._fmt == "json":
+            doc = {"ts": ts, "level": _NAMES[level], "msg": msg}
+            doc.update({k: _scrub(v) for k, v in record.items()})
+            line = json.dumps(doc, default=str)
+        else:
+            pairs = " ".join(f"{k}={_scrub(v)}" for k, v in record.items())
+            line = f"{_NAMES[level]}[{ts}] {msg}" + (f" {pairs}" if pairs else "")
+        with self._lock:
+            print(line, file=self._sink)
+
+
+def _scrub(v):
+    if isinstance(v, bytes):
+        return v.hex()
+    if isinstance(v, Exception):
+        return f"{type(v).__name__}: {v}"
+    return v
+
+
+class NopLogger:
+    """reference: libs/log/nop_logger.go."""
+
+    def with_(self, **kv) -> "NopLogger":
+        return self
+
+    def debug(self, msg: str, **kv) -> None:
+        pass
+
+    def info(self, msg: str, **kv) -> None:
+        pass
+
+    def error(self, msg: str, **kv) -> None:
+        pass
+
+
+def new_logger(level: str = "info", fmt: str = "plain", sink=None) -> Logger:
+    return Logger(sink=sink, level=level, fmt=fmt)

@@ -1,0 +1,243 @@
+"""Prometheus metrics: registry, Counter/Gauge/Histogram, text exposition,
+and the scrape endpoint (reference: the per-subsystem metrics.go files +
+node/node.go:1219 startPrometheusServer).
+
+Pure-stdlib implementation of the Prometheus text format v0.0.4 — no
+client library is baked into the image, and the format is trivial.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...]):
+        self.name = name
+        self.help = help_
+        self.label_names = labels
+        self._values: dict[tuple, float] = {}
+        self._mtx = threading.Lock()
+
+    def _key(self, labels: dict) -> tuple:
+        return tuple(str(labels.get(n, "")) for n in self.label_names)
+
+    def _fmt_labels(self, key: tuple) -> str:
+        if not self.label_names:
+            return ""
+        inner = ",".join(f'{n}="{_escape(v)}"' for n, v in zip(self.label_names, key))
+        return "{" + inner + "}"
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class Counter(_Metric):
+    TYPE = "counter"
+
+    def add(self, delta: float = 1.0, **labels) -> None:
+        if delta < 0:
+            raise ValueError("counters only go up")
+        k = self._key(labels)
+        with self._mtx:
+            self._values[k] = self._values.get(k, 0.0) + delta
+
+    def expose(self) -> list[str]:
+        with self._mtx:
+            return [f"{self.name}{self._fmt_labels(k)} {v}"
+                    for k, v in sorted(self._values.items())]
+
+
+class Gauge(_Metric):
+    TYPE = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._mtx:
+            self._values[self._key(labels)] = float(value)
+
+    def add(self, delta: float = 1.0, **labels) -> None:
+        k = self._key(labels)
+        with self._mtx:
+            self._values[k] = self._values.get(k, 0.0) + delta
+
+    def expose(self) -> list[str]:
+        with self._mtx:
+            return [f"{self.name}{self._fmt_labels(k)} {v}"
+                    for k, v in sorted(self._values.items())]
+
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
+
+
+class Histogram(_Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name, help_, labels, buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_, labels)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        k = self._key(labels)
+        with self._mtx:
+            counts = self._counts.setdefault(k, [0] * len(self.buckets))
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    counts[i] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._totals[k] = self._totals.get(k, 0) + 1
+
+    def expose(self) -> list[str]:
+        out = []
+        with self._mtx:
+            for k, counts in sorted(self._counts.items()):
+                base = dict(zip(self.label_names, k))
+                for i, ub in enumerate(self.buckets):
+                    lk = self._fmt_labels(tuple(list(k)))
+                    labels = (lk[:-1] + "," if lk else "{") + f'le="{ub}"' + "}"
+                    out.append(f"{self.name}_bucket{labels} {counts[i]}")
+                lk = self._fmt_labels(k)
+                inf_labels = (lk[:-1] + "," if lk else "{") + 'le="+Inf"}'
+                out.append(f"{self.name}_bucket{inf_labels} {self._totals[k]}")
+                out.append(f"{self.name}_sum{lk} {self._sums[k]}")
+                out.append(f"{self.name}_count{lk} {self._totals[k]}")
+        return out
+
+
+class Registry:
+    def __init__(self, namespace: str = "tendermint"):
+        self.namespace = namespace
+        self._metrics: list[_Metric] = []
+        self._mtx = threading.Lock()
+
+    def _register(self, cls, subsystem: str, name: str, help_: str,
+                  labels: tuple[str, ...] = (), **kw):
+        full = "_".join(p for p in (self.namespace, subsystem, name) if p)
+        m = cls(full, help_, labels, **kw)
+        with self._mtx:
+            self._metrics.append(m)
+        return m
+
+    def counter(self, subsystem, name, help_="", labels=()) -> Counter:
+        return self._register(Counter, subsystem, name, help_, tuple(labels))
+
+    def gauge(self, subsystem, name, help_="", labels=()) -> Gauge:
+        return self._register(Gauge, subsystem, name, help_, tuple(labels))
+
+    def histogram(self, subsystem, name, help_="", labels=(),
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram, subsystem, name, help_, tuple(labels),
+                              buckets=buckets)
+
+    def expose(self) -> str:
+        lines = []
+        with self._mtx:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.TYPE}")
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+# --- per-subsystem metric structs (reference: */metrics.go) -----------------
+
+
+class NodeMetrics:
+    """The metric set every node exposes (reference: consensus/metrics.go:11,
+    mempool/metrics.go, p2p/metrics.go, state/metrics.go)."""
+
+    def __init__(self, registry: Registry | None = None):
+        r = registry if registry is not None else Registry()
+        self.registry = r
+        # consensus
+        self.height = r.gauge("consensus", "height", "Height of the chain.")
+        self.rounds = r.gauge("consensus", "rounds", "Number of rounds.")
+        self.validators = r.gauge("consensus", "validators", "Number of validators.")
+        self.validators_power = r.gauge(
+            "consensus", "validators_power", "Total power of all validators.")
+        self.missing_validators = r.gauge(
+            "consensus", "missing_validators", "Validators missing from the last commit.")
+        self.byzantine_validators = r.gauge(
+            "consensus", "byzantine_validators", "Validators who tried to double sign.")
+        self.block_interval_seconds = r.histogram(
+            "consensus", "block_interval_seconds",
+            "Time between this and the last block.",
+            buckets=(0.1, 0.25, 0.5, 1, 2, 3, 5, 10, 30))
+        self.num_txs = r.gauge("consensus", "num_txs", "Number of transactions.")
+        self.block_size_bytes = r.gauge(
+            "consensus", "total_txs", "Size of the latest block (bytes).")
+        self.total_txs = r.counter(
+            "consensus", "committed_txs", "Total transactions committed.")
+        self.step_duration = r.histogram(
+            "consensus", "step_duration_seconds", "Time spent per step.",
+            labels=("step",),
+            buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 1, 5))
+        self.batch_verify_seconds = r.histogram(
+            "consensus", "batch_verify_seconds",
+            "Latency of batched signature verification flushes (TPU-path).",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1))
+        self.batch_verify_sigs = r.counter(
+            "consensus", "batch_verify_sigs_total",
+            "Signatures verified through the batch verifier.")
+        # state
+        self.block_processing_time = r.histogram(
+            "state", "block_processing_time",
+            "Time spent processing a block (ApplyBlock).",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2, 5))
+        # mempool
+        self.mempool_size = r.gauge("mempool", "size", "Number of uncommitted txs.")
+        self.mempool_failed_txs = r.counter("mempool", "failed_txs", "Rejected txs.")
+        # p2p
+        self.peers = r.gauge("p2p", "peers", "Number of connected peers.")
+        self.peer_receive_bytes = r.counter(
+            "p2p", "peer_receive_bytes_total", "Bytes received.", labels=("chID",))
+        self.peer_send_bytes = r.counter(
+            "p2p", "peer_send_bytes_total", "Bytes sent.", labels=("chID",))
+
+
+# Global registry hook for hot paths that have no handle on the node (the
+# batch verifier). None until a node enables instrumentation.
+GLOBAL_NODE_METRICS: NodeMetrics | None = None
+
+
+class MetricsServer:
+    """reference: node/node.go:1219 startPrometheusServer."""
+
+    def __init__(self, registry: Registry, addr: str):
+        host, port = addr.rsplit(":", 1) if ":" in addr else ("", addr)
+        registry_ref = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = registry_ref.expose().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)), Handler)
+        self.addr = f"{self._httpd.server_address[0]}:{self._httpd.server_address[1]}"
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="prometheus", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

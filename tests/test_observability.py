@@ -1,0 +1,192 @@
+"""Observability: structured logger, Prometheus registry/exposition,
+tx/block indexer, and the localnet criterion -- metrics scrapeable and
+tx_search returning an indexed tx (reference: libs/log, consensus/metrics.go,
+state/txindex/indexer_service.go)."""
+
+import io
+import json
+import os
+import time
+import urllib.request
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.state.txindex import BlockIndexer, TxIndexer
+from tendermint_tpu.store.db import MemDB
+from tendermint_tpu.types.tx import tx_hash
+from tendermint_tpu.utils.log import NopLogger, new_logger
+from tendermint_tpu.utils.metrics import Counter, Gauge, Histogram, Registry
+
+
+def test_logger_plain_and_json_and_levels():
+    sink = io.StringIO()
+    lg = new_logger(level="info", fmt="plain", sink=sink)
+    lg.debug("invisible", x=1)
+    lg.info("hello", height=5, hash=b"\xab\xcd")
+    lg.error("bad", err=ValueError("boom"))
+    out = sink.getvalue()
+    assert "invisible" not in out
+    assert "INF" in out and "hello" in out and "height=5" in out
+    assert "abcd" in out  # bytes rendered as hex
+    assert "ERR" in out and "ValueError: boom" in out
+
+    sink2 = io.StringIO()
+    jlg = new_logger(level="debug", fmt="json", sink=sink2).with_(module="consensus")
+    jlg.debug("visible", round=2)
+    doc = json.loads(sink2.getvalue())
+    assert doc["module"] == "consensus" and doc["round"] == 2
+    assert doc["level"] == "DBG" and doc["msg"] == "visible"
+
+    # binding is immutable
+    base = new_logger(sink=io.StringIO())
+    bound = base.with_(module="p2p")
+    assert bound._bound == {"module": "p2p"} and base._bound == {}
+
+    NopLogger().with_(x=1).info("goes nowhere")
+
+
+def test_metrics_registry_exposition():
+    r = Registry(namespace="tm")
+    c = r.counter("consensus", "txs_total", "Total txs.")
+    g = r.gauge("p2p", "peers", "Peers.", labels=("dir",))
+    h = r.histogram("state", "apply_seconds", "Apply time.", buckets=(0.1, 1.0))
+    c.add(3)
+    g.set(4, dir="out")
+    g.set(2, dir="in")
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = r.expose()
+    assert "# TYPE tm_consensus_txs_total counter" in text
+    assert "tm_consensus_txs_total 3.0" in text
+    assert 'tm_p2p_peers{dir="out"} 4.0' in text
+    assert 'tm_p2p_peers{dir="in"} 2.0' in text
+    assert 'tm_state_apply_seconds_bucket{le="0.1"} 1' in text
+    assert 'tm_state_apply_seconds_bucket{le="1.0"} 2' in text
+    assert 'tm_state_apply_seconds_bucket{le="+Inf"} 3' in text
+    assert "tm_state_apply_seconds_count 3" in text
+    assert "tm_state_apply_seconds_sum 5.55" in text
+
+
+def _mk_result(events=None, code=0):
+    return abci.ResponseDeliverTx(code=code, data=b"ok", gas_wanted=1,
+                                  events=events or [])
+
+
+def test_tx_indexer_index_get_search():
+    idx = TxIndexer(MemDB())
+    ev = [abci.Event(type="transfer", attributes=[
+        abci.EventAttribute(key=b"sender", value=b"alice", index=True),
+        abci.EventAttribute(key=b"memo", value=b"secret", index=False),
+    ])]
+    idx.index(7, 0, b"tx-one", _mk_result(ev))
+    idx.index(7, 1, b"tx-two", _mk_result())
+    idx.index(9, 0, b"tx-three", _mk_result(ev))
+
+    doc = idx.get(tx_hash(b"tx-one"))
+    assert doc["height"] == "7" and doc["index"] == 0
+    assert doc["tx_result"]["events"][0]["type"] == "transfer"
+
+    by_height = idx.search("tx.height=7")
+    assert [d["index"] for d in by_height] == [0, 1]
+    by_event = idx.search("transfer.sender=alice")
+    assert len(by_event) == 2
+    both = idx.search("transfer.sender=alice AND tx.height=9")
+    assert len(both) == 1 and both[0]["height"] == "9"
+    # unindexed attributes are not searchable
+    assert idx.search("transfer.memo=secret") == []
+    assert idx.search("transfer.sender=bob") == []
+
+
+def test_block_indexer_search():
+    idx = BlockIndexer(MemDB())
+    ev = [abci.Event(type="rewards", attributes=[
+        abci.EventAttribute(key=b"epoch", value=b"4", index=True)])]
+    idx.index(3, ev, [])
+    idx.index(5, [], ev)
+    assert idx.has(3) and idx.has(5) and not idx.has(4)
+    assert idx.search("rewards.epoch=4") == [3, 5]
+    assert idx.search("rewards.epoch=4 AND block.height=5") == [5]
+
+
+def test_localnet_metrics_and_tx_search(tmp_path):
+    """The VERDICT criterion: metrics scrapeable; tx_search returns an
+    indexed tx."""
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.privval.file_pv import MockPV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types.ttime import Time
+
+    priv = ed25519.gen_priv_key(b"\x91" * 32)
+    genesis = GenesisDoc(
+        chain_id="obs-chain", genesis_time=Time(1700003000, 0),
+        validators=[GenesisValidator(b"", priv.pub_key(), 10)],
+    )
+    cfg = test_config()
+    cfg.set_root(str(tmp_path / "node"))
+    os.makedirs(cfg.base.root_dir, exist_ok=True)
+    cfg.base.fast_sync_mode = False
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.consensus.wal_path = ""
+    cfg.tx_index.indexer = "kv"
+    cfg.instrumentation.prometheus = True
+    cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+    node = Node(cfg, genesis=genesis, priv_validator=MockPV(priv),
+                node_key=NodeKey(ed25519.gen_priv_key(b"\x92" * 32)))
+    node.start()
+    try:
+        node.mempool.check_tx(b"observed=yes")
+        deadline = time.monotonic() + 60
+        h = tx_hash(b"observed=yes")
+        while time.monotonic() < deadline and node.tx_indexer.get(h) is None:
+            time.sleep(0.1)
+        doc = node.tx_indexer.get(h)
+        assert doc is not None and doc["tx_result"]["code"] == 0
+
+        # tx_search over RPC
+        base = "http://" + node.rpc_server.laddr.split("://", 1)[1]
+        body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": "tx_search",
+                           "params": {"query": f"tx.height={doc['height']}"}}).encode()
+        with urllib.request.urlopen(urllib.request.Request(
+                base, data=body, headers={"Content-Type": "application/json"}),
+                timeout=10) as r:
+            res = json.loads(r.read())["result"]
+        assert int(res["total_count"]) >= 1
+        assert any(t["hash"] == h.hex().upper() for t in res["txs"])
+        # tx route by hash
+        body = json.dumps({"jsonrpc": "2.0", "id": 2, "method": "tx",
+                           "params": {"hash": __import__("base64").b64encode(h).decode()}}).encode()
+        with urllib.request.urlopen(urllib.request.Request(
+                base, data=body, headers={"Content-Type": "application/json"}),
+                timeout=10) as r:
+            res = json.loads(r.read())["result"]
+        assert res["height"] == doc["height"]
+
+        # block events from kvstore's DeliverTx (creator attr) are indexed
+        assert node.tx_indexer.search("app.creator=kvstore")
+
+        # Prometheus scrape (poll: gauges update on a 0.25s sampler tick)
+        def scrape():
+            with urllib.request.urlopen(
+                    f"http://{node.metrics_server.addr}/metrics", timeout=10) as r:
+                return r.read().decode()
+
+        text = scrape()
+        while time.monotonic() < deadline:
+            hval = [ln for ln in text.splitlines()
+                    if ln.startswith("tendermint_consensus_height ")]
+            if hval and float(hval[0].split()[-1]) >= 1:
+                break
+            time.sleep(0.2)
+            text = scrape()
+        assert hval and float(hval[0].split()[-1]) >= 1
+        assert "tendermint_mempool_size" in text
+        assert "tendermint_state_block_processing_time_count" in text
+    finally:
+        node.stop()
+        from tendermint_tpu.utils import metrics as tmmetrics
+
+        tmmetrics.GLOBAL_NODE_METRICS = None
