@@ -230,9 +230,18 @@ class BlockchainReactor(Reactor):
             if now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL_S:
                 last_switch_check = now
                 caught_up = self.pool.is_caught_up()
+                # The no-peer bailout exists for solo/dev nodes; a node that
+                # HAS peers configured (persistent peers or a PEX book that
+                # can still produce some) must keep waiting instead of
+                # silently skipping sync on a cold start.
                 waited_enough = now - started_at > 3.0
                 no_peers = self.switch is None or not self.switch.peers
-                if caught_up or (waited_enough and no_peers):
+                expects_peers = self.switch is not None and (
+                    self.switch._persistent_addrs
+                    or any(r.name == "PEX" and not r.book.is_empty()
+                           for r in self.switch.reactors.values()
+                           if hasattr(r, "book")))
+                if caught_up or (waited_enough and no_peers and not expects_peers):
                     self._running = False
                     self._synced.set()
                     if self.consensus_reactor is not None:

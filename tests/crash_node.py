@@ -1,0 +1,84 @@
+"""Subprocess driver for the crash-recovery fail-point matrix
+(tests/test_fastsync_recovery.py). Runs a single-validator node on durable
+stores; with TMTPU_FAIL_INDEX set the node os._exit()s mid-commit at the
+chosen fail site, simulating a hard crash. In recovery mode it replays
+WAL + block store through the app and prints a JSON state summary.
+
+Usage: python tests/crash_node.py <root_dir> <mode:crash|recover> <target_height>
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TM_TPU_DISABLE_BATCH", "1")  # no kernel warmup needed here
+
+from tendermint_tpu.config.config import test_config  # noqa: E402
+from tendermint_tpu.crypto import ed25519  # noqa: E402
+from tendermint_tpu.node.node import Node  # noqa: E402
+from tendermint_tpu.p2p.key import NodeKey  # noqa: E402
+from tendermint_tpu.privval.file_pv import FilePV  # noqa: E402
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator  # noqa: E402
+from tendermint_tpu.types.ttime import Time  # noqa: E402
+
+
+def main() -> int:
+    root, mode, target_height = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    os.makedirs(root, exist_ok=True)
+
+    pv = FilePV.load_or_generate(os.path.join(root, "pv_key.json"),
+                                 os.path.join(root, "pv_state.json"))
+    genesis = GenesisDoc(
+        chain_id="crash-chain", genesis_time=Time(1700003000, 0),
+        validators=[GenesisValidator(b"", pv.get_pub_key(), 10)],
+    )
+    cfg = test_config()
+    cfg.set_root(root)
+    os.makedirs(os.path.join(root, "data"), exist_ok=True)
+    cfg.base.db_backend = "sqlite"  # durable across the crash
+    cfg.base.fast_sync_mode = False
+    cfg.p2p.laddr = ""
+    cfg.p2p.pex = False
+    cfg.rpc.laddr = ""
+    cfg.consensus.wal_path = os.path.join(root, "data", "cs.wal")
+
+    node = Node(cfg, genesis=genesis, priv_validator=pv,
+                node_key=NodeKey(ed25519.gen_priv_key(b"\x55" * 32)))
+    node.start()
+
+    # feed a tx per block so the app state actually advances
+    deadline = time.monotonic() + 120
+    fed = 0
+    while time.monotonic() < deadline:
+        h = node.block_store.height
+        if fed <= h:
+            try:
+                node.mempool.check_tx(b"%s%d=v%d" % (mode.encode(), fed, fed))
+            except Exception:  # noqa: BLE001 - dupes after replay are expected
+                pass
+            fed += 1
+        if mode == "recover" and h >= target_height:
+            break
+        time.sleep(0.05)
+        # In crash mode the process never reaches here past the fail site:
+        # os._exit fires inside finalize_commit on the consensus thread.
+    node.stop()
+
+    app = node.app  # in-proc kvstore
+    st = node.state_store.load()
+    print(json.dumps({
+        "height": node.block_store.height,
+        "state_height": st.last_block_height,
+        "state_app_hash": st.app_hash.hex(),
+        "app_height": app.height,
+        "app_hash": app.app_hash.hex(),
+        "app_size": app.size,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
