@@ -200,14 +200,36 @@ def _round_up(n: int, m: int) -> int:
 
 
 @jax.jit
-def _gather_transpose(tab_ext, idx):
-    """(Kb, 16, 4, 20), (nb,) -> (1280, nb) lane-major per-item tables.
+def _gather_transpose(tab_rows, idx):
+    """(Kb, R), (nb,) -> (R, nb) lane-major per-item tables.
 
     Gather along the MAJOR axis then transpose: a lane-axis gather is
     pathologically slow on TPU, a row gather + transpose is fast."""
-    k = tab_ext.shape[0]
-    rows = jnp.take(tab_ext.reshape(k, 1280), idx, axis=0)  # (nb, 1280)
+    rows = jnp.take(tab_rows, idx, axis=0)  # (nb, R)
     return rows.T
+
+
+@jax.jit
+def _to_niels(tab_ext):
+    """(Kb, 16, 4, 20) extended comb points -> (Kb, 960) niels rows
+    (y+x | y-x | 2dxy per entry, affine via batched Z inversion).
+
+    Niels form turns the kernel's per-entry table add from a 9-mul full
+    extended add into a 7-mul mixed add AND shrinks the per-iteration table
+    read by 25% (60 rows/entry vs 80). One batched inversion per key set,
+    amortized across every height that reuses the set."""
+    from tendermint_tpu.ops import field25519 as fe
+
+    X, Y, Z = tab_ext[:, :, 0], tab_ext[:, :, 1], tab_ext[:, :, 2]
+    zinv = fe.inv(Z)
+    x = fe.mul(X, zinv)
+    y = fe.mul(Y, zinv)
+    ypx = fe.add(y, x)
+    ymx = fe.sub(y, x)
+    txy = fe.mul(fe.mul(x, y), jnp.asarray(ed.TWO_D_LIMBS))
+    k = tab_ext.shape[0]
+    niels = jnp.stack([ypx, ymx, txy], axis=2)  # (Kb, 16, 3, 20)
+    return niels.reshape(k, 960)
 
 
 # ---------------------------------------------------------------------------
@@ -241,7 +263,7 @@ class KeySet:
     built lazily. `key_idx` maps item slot -> table row for the exact pubkey
     sequence this KeySet was built from."""
 
-    __slots__ = ("n_keys", "valid", "tab_ext", "key_idx", "_gathered")
+    __slots__ = ("n_keys", "valid", "tab_ext", "key_idx", "_gathered", "_niels")
 
     def __init__(self, n_keys, valid, tab_ext, key_idx):
         self.n_keys = n_keys
@@ -249,9 +271,16 @@ class KeySet:
         self.tab_ext = tab_ext
         self.key_idx = key_idx
         self._gathered: OrderedDict = OrderedDict()
+        self._niels = None
+
+    def niels_rows(self):
+        """(Kb, 960) niels-form comb tables, built on device once per set."""
+        if self._niels is None:
+            self._niels = _to_niels(self.tab_ext)
+        return self._niels
 
     def gathered_lane(self, idx: np.ndarray):
-        """(1280, nb) lane-major comb tables for a padded index pattern,
+        """(960, nb) lane-major niels comb tables for a padded index pattern,
         cached per pattern. Steady-state commit verification reuses the same
         (validator-order) pattern every height, so the device-side gather +
         transpose runs once per validator set, not once per call."""
@@ -260,7 +289,7 @@ class KeySet:
         if hit is not None:
             self._gathered.move_to_end(key)
             return hit
-        tab = _gather_transpose(self.tab_ext, jnp.asarray(idx))
+        tab = _gather_transpose(self.niels_rows(), jnp.asarray(idx))
         self._gathered[key] = tab
         # Large batches dispatch in fixed CHUNK slices (ed25519_pallas), so a
         # steady-state 20k-sig commit needs ~5-8 resident chunk patterns.
@@ -348,11 +377,13 @@ def _r_to_limbs(r32: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return limbs.astype(np.int32), sign
 
 
-def prepare_scalars(items, pub_ok: np.ndarray):
-    """Vectorized per-signature prep: windows, R limbs, validity.
+def prepare_scalars(items, pub_ok: np.ndarray, windows: bool = True):
+    """Vectorized per-signature prep: scalars, R bytes, validity.
 
     items: [(pub, msg, sig)]; pub_ok from get_keyset. Returns dict of numpy
-    arrays sized to len(items) (unpadded)."""
+    arrays sized to len(items) (unpadded). With windows=False (the Pallas
+    path) the comb windows are left to the device and only raw h32/s32
+    scalars are produced -- 40% less H2D payload."""
     n = len(items)
     sig_ok = np.fromiter(
         (len(it[2]) == ref.SIGNATURE_SIZE for it in items), dtype=bool, count=n
@@ -376,10 +407,14 @@ def prepare_scalars(items, pub_ok: np.ndarray):
     digests = chash.sha512_rab(r32, np.ascontiguousarray(pubs_arr),
                                [it[1] for it in items])
     h32 = sc.reduce_mod_l(digests)
-    h_win = sc.comb_windows(h32)
-    s_win = sc.comb_windows(s32)
     valid = sig_ok & s_lt & pub_ok
-    return dict(h_win=h_win, s_win=s_win, r32=r32, valid=valid)
+    out = dict(h32=h32, s32=s32, r32=r32, valid=valid)
+    if windows:
+        out["h_win"] = sc.comb_windows(h32)
+        out["s_win"] = sc.comb_windows(s32)
+    return out
+
+
 
 
 def _jnp_args(s: dict, n: int, nb: int) -> dict:
@@ -439,9 +474,10 @@ def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
     # Non-decompressable keys get an identity comb table; they must be
     # rejected here, exactly as the scalar path's _decompress(pub) is None.
     pub_ok = pub_ok & ks.valid[key_idx]
-    s = prepare_scalars(items, pub_ok)
+    use_pallas = _use_pallas()
+    s = prepare_scalars(items, pub_ok, windows=not use_pallas)
 
-    if _use_pallas():
+    if use_pallas:
         from tendermint_tpu.ops import ed25519_pallas
 
         ok = ed25519_pallas.verify_with_keyset(ks, key_idx, s)

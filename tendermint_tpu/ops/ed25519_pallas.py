@@ -11,11 +11,11 @@ the point state never leaves VMEM. Layout choices:
    computes all carries at once and shifts them down one limb row (with the
    2^260 === 608 fold wrapping row 19 -> row 0). Pass counts per op are fixed
    by worst-case bound analysis (see _carry_n).
- * per-key comb tables (16 x 4 x 20 extended points) come in as a kernel
-   INPUT (1280 rows x T lanes), gathered from the device-resident KeySet
-   cache by validator index - nothing per-key is rebuilt per call. The
-   fixed-base comb table for B is baked in as niels-form constants
-   (y+x, y-x, 2dxy), making the B addition a 7-mul mixed add.
+ * per-key comb tables come in NIELS form (16 entries x 3 field elements
+   y+x | y-x | 2dxy = 60 rows/entry, 960 rows x T lanes), gathered from the
+   device-resident KeySet cache by validator index - nothing per-key is
+   rebuilt per call, and each table addition is a 7-mul mixed add. The
+   fixed-base comb table for B is baked in as niels constants the same way.
 
 Bound discipline matches ops/field25519: all stored limbs < 9500, products
 and 20-term accumulations stay below 2^31 in int32 (squaring's doubled
@@ -36,11 +36,17 @@ from tendermint_tpu.ops import ed25519_batch as edb
 from tendermint_tpu.ops import edwards25519 as ed
 from tendermint_tpu.ops import field25519 as fe
 
+import os
+
 MASK = fe.MASK
 FOLD = fe.FOLD
 NLIMB = fe.NLIMB
 P = fe.P
-TILE = 256  # lanes per grid step (multiple of 128)
+# Lanes per grid step (multiple of 128). 256 measured best on v5e; larger
+# tiles spill VMEM (TILE=512 benched 2.6x slower end to end).
+TILE = int(os.environ.get("TM_TPU_PALLAS_TILE", "256"))
+if TILE % 128 != 0 or TILE <= 0:
+    raise ValueError(f"TM_TPU_PALLAS_TILE must be a positive multiple of 128, got {TILE}")
 
 _PSUB = np.asarray(fe.PSUB_LIMBS, dtype=np.int32).reshape(NLIMB, 1)
 _P_CANON = np.asarray(fe.P_LIMBS, dtype=np.int32).reshape(NLIMB, 1)
@@ -293,10 +299,11 @@ def _kernel(consts_ref, tab_ref, h_win_ref, s_win_ref, r_y_ref, r_sv_ref, ok_ref
         acc = _pt_double(acc)
         wh = h_win_ref[pl.ds(j, 1), :]  # (1, T)
         ws = s_win_ref[pl.ds(j, 1), :]
-        # comb point of -A: 16-way select over the gathered per-key table
-        rows = [tab_ref[k * 80 : k * 80 + 80, :] for k in range(16)]
+        # comb point of -A: 16-way select over the gathered per-key NIELS
+        # table (60 rows/entry; mixed add = 7 muls vs 9 for extended add)
+        rows = [tab_ref[k * 60 : k * 60 + 60, :] for k in range(16)]
         pa = _select16(wh, rows)
-        acc = _pt_add(acc, (pa[0:20], pa[20:40], pa[40:60], pa[60:80]))
+        acc = _pt_madd_niels(acc, pa[0:20], pa[20:40], pa[40:60])
         # comb point of B from niels constants ((20,1) broadcast over lanes)
         ypx = _select16(ws, [tab_b(k, 0) for k in range(16)])
         ymx = _select16(ws, [tab_b(k, 1) for k in range(16)])
@@ -320,8 +327,8 @@ def _kernel(consts_ref, tab_ref, h_win_ref, s_win_ref, r_y_ref, r_sv_ref, ok_ref
 
 
 def _pallas_verify(tab, h_win, s_win, r_y, r_sv, *, interpret=False):
-    """tab (1280,N), h_win (64,N), s_win (64,N), r_y (20,N), r_sv (2,N)
-    -> ok (1, N) int32. N must be a multiple of TILE."""
+    """tab (960,N) niels rows, h_win (64,N), s_win (64,N), r_y (20,N),
+    r_sv (2,N) -> ok (1, N) int32. N must be a multiple of TILE."""
     n = tab.shape[1]
     grid = (n // TILE,)
 
@@ -335,7 +342,7 @@ def _pallas_verify(tab, h_win, s_win, r_y, r_sv, *, interpret=False):
         _kernel,
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
         grid=grid,
-        in_specs=[consts_spec, spec(1280), spec(64), spec(64), spec(20), spec(2)],
+        in_specs=[consts_spec, spec(960), spec(64), spec(64), spec(20), spec(2)],
         out_specs=spec(1),
         interpret=interpret,
     )(jnp.asarray(CONSTS), tab, h_win, s_win, r_y, r_sv)
@@ -360,7 +367,7 @@ def _r_limbs_device(r32):
 
 @jax.jit
 def verify_kernel_pallas(tab, h_win, s_win, r32, valid):
-    """tab (1280, N) int32 (pre-gathered comb tables, device-resident);
+    """tab (960, N) int32 (pre-gathered niels tables, device-resident);
     h_win/s_win (64, N) uint8; r32 (32, N) uint8; valid (1, N) uint8.
     -> ok (1, N) int32. One upload of packed uint8 per call, one readback."""
     hw = h_win.astype(jnp.int32)
@@ -370,43 +377,92 @@ def verify_kernel_pallas(tab, h_win, s_win, r32, valid):
     return _pallas_verify(tab, hw, sw, r_y, r_sv)
 
 
+def _windows_device(s32):
+    """(32, T) uint8 LE scalars -> (64, T) int32 comb windows in processing
+    order (mirrors scalar25519.comb_windows exactly: w_j = b_j + 2 b_{64+j}
+    + 4 b_{128+j} + 8 b_{192+j}, emitted j=63..0). Runs as fused XLA bit
+    ops so the host uploads 32 raw bytes per scalar instead of 64 window
+    bytes -- H2D payload is the bottleneck over a tunneled chip."""
+    b = s32.astype(jnp.int32)
+    rows = []
+    for i in range(64):
+        j = 63 - i
+        w = None
+        for t in range(4):
+            k = j + 64 * t
+            bit = (b[k // 8] >> (k % 8)) & 1
+            w = bit if w is None else w | (bit << t)
+        rows.append(w)
+    return jnp.stack(rows)
+
+
+@jax.jit
+def _verify_chunk(tab, h32, s32, r32, valid):
+    """One fixed-shape chunk: tab (960, CHUNK) int32 device-resident niels
+    tables; h32/s32/r32 (32, CHUNK) uint8; valid (1, CHUNK) uint8."""
+    hw = _windows_device(h32)
+    sw = _windows_device(s32)
+    r_y, sign = _r_limbs_device(r32)
+    r_sv = jnp.concatenate([sign, valid.astype(jnp.int32)], axis=0)
+    return _pallas_verify(tab, hw, sw, r_y, r_sv)
+
+
+@jax.jit
+def _verify_chunk_at(tab, h32, s32, r32, valid, off):
+    """Chunk slicing moved on-device: the FULL padded batch uploads once
+    (4 arrays), each chunk slices at a traced offset. One executable per
+    padded batch width (consensus batch sizes are stable height to height,
+    and the persistent compile cache covers restarts); per-call H2D drops
+    from 4*n_chunks transfers to 4."""
+    h = jax.lax.dynamic_slice_in_dim(h32, off, CHUNK, axis=1)
+    s = jax.lax.dynamic_slice_in_dim(s32, off, CHUNK, axis=1)
+    r = jax.lax.dynamic_slice_in_dim(r32, off, CHUNK, axis=1)
+    v = jax.lax.dynamic_slice_in_dim(valid, off, CHUNK, axis=1)
+    return _verify_chunk(tab, h, s, r, v)
+
+
 # Fixed dispatch shape: XLA compiles one executable per input shape, so the
 # pallas call always runs at a multiple of CHUNK lanes (small batches pad to
 # one CHUNK; large ones loop). A fresh batch size must never trigger a cold
 # compile inside the consensus loop.
-import os as _os
-
-CHUNK = int(_os.environ.get("TM_TPU_PALLAS_CHUNK", str(16 * TILE)))  # 4096
+CHUNK = int(os.environ.get("TM_TPU_PALLAS_CHUNK", str(16 * TILE)))  # 4096
+if CHUNK % TILE != 0 or CHUNK <= 0:
+    # A non-multiple silently truncates the pallas grid and leaves trailing
+    # output lanes unwritten -- wrong verify results, not an error.
+    raise ValueError(
+        f"TM_TPU_PALLAS_CHUNK must be a positive multiple of TILE={TILE}, got {CHUNK}")
 
 
 def verify_with_keyset(ks, key_idx: np.ndarray, s: dict) -> np.ndarray:
     """High-level entry used by ed25519_batch.verify_batch on TPU backends.
 
     ks: ed25519_batch.KeySet; key_idx (n,) int32; s: prepare_scalars output
-    (unpadded). Returns (n,) bool."""
+    (unpadded, with raw h32/s32 scalars). Returns (n,) bool.
+
+    Per chunk the host ships 97 bytes/sig (h32+s32+r32+valid) as contiguous
+    uint8 blocks; windows and R limb-splitting happen on device. All chunk
+    dispatches are async -- device compute of chunk i overlaps host staging
+    of chunk i+1 -- with one blocking readback at the end."""
     n = key_idx.shape[0]
     nb = -(-n // CHUNK) * CHUNK
 
     idx = np.zeros((nb,), dtype=np.int32)
     idx[:n] = key_idx
 
-    def padT(x, rows):
+    def pad_cols(x, rows):
         out = np.zeros((rows, nb), dtype=np.uint8)
         out[:, :n] = x.T if x.ndim == 2 else x[None, :]
         return out
 
-    h_win = padT(s["h_win"], 64)
-    s_win = padT(s["s_win"], 64)
-    r32 = padT(s["r32"], 32)
-    valid = padT(s["valid"].astype(np.uint8), 1)
+    h32 = jnp.asarray(pad_cols(s["h32"], 32))
+    s32 = jnp.asarray(pad_cols(s["s32"], 32))
+    r32 = jnp.asarray(pad_cols(s["r32"], 32))
+    valid = jnp.asarray(pad_cols(s["valid"].astype(np.uint8), 1))
 
     outs = []
     for off in range(0, nb, CHUNK):
-        sl = slice(off, off + CHUNK)
-        tab = ks.gathered_lane(idx[sl])  # cached per gossip/commit pattern
-        outs.append(verify_kernel_pallas(
-            tab, jnp.asarray(h_win[:, sl]), jnp.asarray(s_win[:, sl]),
-            jnp.asarray(r32[:, sl]), jnp.asarray(valid[:, sl]),
-        ))
+        tab = ks.gathered_lane(idx[off:off + CHUNK])  # cached per pattern
+        outs.append(_verify_chunk_at(
+            tab, h32, s32, r32, valid, jnp.int32(off)))
     ok = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
     return np.asarray(ok)[0, :n].astype(bool)

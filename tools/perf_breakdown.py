@@ -1,0 +1,115 @@
+"""Perf breakdown for the batched verifier on the current backend.
+
+Phases timed independently at N=BENCH_N_SIGS (default 20480):
+  keyset   get_keyset cache hit
+  prep     host scalar prep (SHA-512, reduce mod L, validity)
+  stage    padding + per-chunk transposes (host)
+  device   kernel wall time with pre-staged device inputs (block_until_ready)
+  e2e      full verify_batch
+
+Run: python tools/perf_breakdown.py
+"""
+
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+N = int(os.environ.get("BENCH_N_SIGS", 20480))
+
+
+def t(fn, iters=5):
+    out = []
+    for _ in range(iters):
+        t0 = time.monotonic()
+        fn()
+        out.append((time.monotonic() - t0) * 1000)
+    return statistics.median(out)
+
+
+def main():
+    import jax
+
+    from tendermint_tpu.crypto import ed25519 as ref
+    from tendermint_tpu.ops import ed25519_batch as edb
+    from tendermint_tpu.ops import ed25519_pallas as edp
+
+    print("backend:", jax.default_backend(), "chunk:", edp.CHUNK)
+    n_vals = N // 2
+    privs = [ref.gen_priv_key(i.to_bytes(4, "big") * 8) for i in range(n_vals)]
+    items = []
+    for r in range(2):
+        for i in range(n_vals):
+            msg = b"breakdown" + r.to_bytes(2, "big") + i.to_bytes(4, "big") + bytes(80)
+            items.append((privs[i].pub_key().data, msg, ref.sign(privs[i].data, msg)))
+
+    # end-to-end warm
+    assert edb.verify_batch(items).all()
+    print("e2e      %8.1f ms" % t(lambda: edb.verify_batch(items)))
+
+    pubs = [it[0] for it in items]
+    print("keyset   %8.1f ms" % t(lambda: edb.get_keyset(pubs)))
+
+    ks, key_idx, pub_ok = edb.get_keyset(pubs)
+    pub_ok = pub_ok & ks.valid[key_idx]
+    print("prep     %8.1f ms" % t(lambda: edb.prepare_scalars(items, pub_ok, windows=False)))
+
+    s = edb.prepare_scalars(items, pub_ok, windows=False)
+    n = len(items)
+    nb = -(-n // edp.CHUNK) * edp.CHUNK
+    idx = np.zeros((nb,), dtype=np.int32)
+    idx[:n] = key_idx
+
+    def stage():
+        h32 = np.zeros((nb, 32), np.uint8); h32[:n] = s["h32"]
+        s32 = np.zeros((nb, 32), np.uint8); s32[:n] = s["s32"]
+        r32 = np.zeros((nb, 32), np.uint8); r32[:n] = s["r32"]
+        v = np.zeros((nb, 1), np.uint8); v[:n, 0] = s["valid"]
+        out = []
+        for off in range(0, nb, edp.CHUNK):
+            sl = slice(off, off + edp.CHUNK)
+            out.append((np.ascontiguousarray(h32[sl].T), np.ascontiguousarray(s32[sl].T),
+                        np.ascontiguousarray(r32[sl].T), np.ascontiguousarray(v[sl].T)))
+        return out
+
+    print("stage    %8.1f ms" % t(stage))
+
+    staged = stage()
+    tabs = [ks.gathered_lane(idx[off:off + edp.CHUNK])
+            for off in range(0, nb, edp.CHUNK)]
+    import jax.numpy as jnp
+
+    dev = [tuple(jnp.asarray(x) for x in ch) for ch in staged]
+    for tab in tabs:
+        tab.block_until_ready()
+
+    def device_only():
+        outs = [edp._verify_chunk(tab, *ch) for tab, ch in zip(tabs, dev)]
+        for o in outs:
+            o.block_until_ready()
+
+    device_only()
+    print("device   %8.1f ms" % t(device_only))
+
+    def upload():
+        return [tuple(jnp.asarray(x) for x in ch) for ch in staged]
+
+    ups = upload()
+    for ch in ups:
+        for x in ch:
+            x.block_until_ready()
+
+    def upload_timed():
+        for ch in upload():
+            for x in ch:
+                x.block_until_ready()
+
+    print("upload   %8.1f ms" % t(upload_timed))
+
+
+if __name__ == "__main__":
+    main()
