@@ -1,0 +1,189 @@
+"""secp256k1 + sr25519 key types: spec vectors for every layer of the
+from-scratch stacks (keccak/SHA3 cross-check, merlin transcript vector,
+ristretto255 RFC 9496 vectors), sign/verify round-trips, registry routing,
+and mixed-key commit verification (BASELINE config 4 shape)."""
+
+import hashlib
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519, keys, secp256k1, sr25519
+
+
+# --- keccak-f1600 cross-checked via SHA3-256 against hashlib ----------------
+
+def _sha3_256(data: bytes) -> bytes:
+    rate = 136
+    st = bytearray(200)
+    padded = bytearray(data)
+    padded.append(0x06)
+    while len(padded) % rate != 0:
+        padded.append(0)
+    padded[-1] |= 0x80
+    for off in range(0, len(padded), rate):
+        for i in range(rate):
+            st[i] ^= padded[off + i]
+        sr25519.keccak_f1600(st)
+    return bytes(st[:32])
+
+
+def test_keccak_f1600_against_hashlib_sha3():
+    for msg in (b"", b"abc", b"x" * 135, b"y" * 136, b"z" * 1000):
+        assert _sha3_256(msg) == hashlib.sha3_256(msg).digest()
+
+
+# --- merlin transcript (vector from merlin's own test suite) ----------------
+
+def test_merlin_transcript_vector():
+    t = sr25519.Transcript(b"test protocol")
+    t.append_message(b"some label", b"some data")
+    cb = t.challenge_bytes(b"challenge", 32)
+    assert cb.hex() == \
+        "d5a21972d0d5fe320c0d263fac7fffb8145aa640af6e9bca177c03c7efcf0615"
+
+
+# --- ristretto255 (RFC 9496 appendix A vectors) ------------------------------
+
+RISTRETTO_BASE_MULTIPLES = [
+    "0000000000000000000000000000000000000000000000000000000000000000",
+    "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+    "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+    "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259",
+    "da80862773358b466ffadfe0b3293ab3d9fd53c5ea6c955358f568322daf6a57",
+]
+
+BAD_RISTRETTO = [
+    # non-canonical field element
+    "ecffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+    # negative field element
+    "0100000000000000000000000000000000000000000000000000000000000080",
+    # non-square x^2
+    "26948d35ca62e643e26a83177332e6b6afeb9d08e4268b650f1f5bbd8d81d371",
+]
+
+
+def test_ristretto_base_multiples():
+    acc = (0, 1, 1, 0)  # identity in extended coords
+    base = ed25519.BASE
+    for i, want in enumerate(RISTRETTO_BASE_MULTIPLES):
+        got = sr25519.ristretto_encode(acc)
+        assert got.hex() == want, f"multiple {i}"
+        # decode round-trips to an equal point
+        dec = sr25519.ristretto_decode(got)
+        assert dec is not None and sr25519.ristretto_eq(dec, acc)
+        acc = sr25519._pt_add(acc, base)
+
+
+def test_ristretto_bad_encodings_rejected():
+    for bad in BAD_RISTRETTO:
+        assert sr25519.ristretto_decode(bytes.fromhex(bad)) is None
+
+
+# --- sr25519 sign/verify ------------------------------------------------------
+
+def test_sr25519_sign_verify_roundtrip():
+    priv = sr25519.gen_priv_key(b"sr-test-seed")
+    pub = priv.pub_key()
+    assert len(pub.bytes()) == 32 and len(pub.address()) == 20
+    msg = b"the quick brown fox"
+    sig = priv.sign(msg)
+    assert len(sig) == 64 and sig[63] & 128
+    assert pub.verify_signature(msg, sig)
+    # randomized signing: two signatures differ, both verify
+    sig2 = priv.sign(msg)
+    assert sig2 != sig and pub.verify_signature(msg, sig2)
+    # tamper rejection
+    assert not pub.verify_signature(msg + b"!", sig)
+    bad = sig[:-1] + bytes([sig[-1] ^ 1])
+    assert not pub.verify_signature(msg, bad)
+    assert not pub.verify_signature(msg, sig[:63])
+    # unmarked signature rejected (schnorrkel marker bit)
+    unmarked = sig[:63] + bytes([sig[63] & 127])
+    assert not pub.verify_signature(msg, unmarked)
+    # wrong key rejected
+    other = sr25519.gen_priv_key(b"other").pub_key()
+    assert not other.verify_signature(msg, sig)
+
+
+def test_sr25519_deterministic_with_seeded_rng():
+    mini = hashlib.sha256(b"det").digest()
+    s1 = sr25519.sign(mini, b"m", rng_seed=b"\x00" * 32)
+    s2 = sr25519.sign(mini, b"m", rng_seed=b"\x00" * 32)
+    assert s1 == s2
+    assert sr25519.verify(sr25519.pubkey_from_mini(mini), b"m", s1)
+
+
+# --- secp256k1 ---------------------------------------------------------------
+
+def test_secp256k1_sign_verify_roundtrip():
+    priv = secp256k1.gen_priv_key(b"secp-test-seed")
+    pub = priv.pub_key()
+    assert len(pub.bytes()) == 33 and pub.bytes()[0] in (2, 3)
+    assert len(pub.address()) == 20
+    msg = b"pay to the order of"
+    sig = priv.sign(msg)
+    assert len(sig) == 64
+    assert pub.verify_signature(msg, sig)
+    # deterministic RFC 6979: same msg -> same sig
+    assert priv.sign(msg) == sig
+    # low-S enforced: the complement is rejected
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    assert s <= secp256k1.HALF_N
+    high = r.to_bytes(32, "big") + (secp256k1.N - s).to_bytes(32, "big")
+    assert not pub.verify_signature(msg, high)
+    assert not pub.verify_signature(msg + b"!", sig)
+    assert not pub.verify_signature(msg, sig[:-1] + bytes([sig[-1] ^ 1]))
+
+
+def test_secp256k1_known_curve_identity():
+    # n*G = infinity; (n-1)*G = -G
+    assert secp256k1._to_affine(secp256k1._jac_mul(secp256k1.N, secp256k1._G)) is None
+    m = secp256k1._to_affine(secp256k1._jac_mul(secp256k1.N - 1, secp256k1._G))
+    assert m == (secp256k1.GX, secp256k1.P - secp256k1.GY)
+
+
+# --- registry + mixed batch verification -------------------------------------
+
+def test_registry_roundtrip_all_types():
+    for mod, name in ((ed25519, "ed25519"), (sr25519, "sr25519"),
+                      (secp256k1, "secp256k1")):
+        priv = mod.gen_priv_key(b"registry-seed-0123456789abcdef##")
+        pub = keys.pubkey_from_type_bytes(name, priv.pub_key().bytes())
+        assert pub.type == name
+        sig = priv.sign(b"reg")
+        assert pub.verify_signature(b"reg", sig)
+        priv2 = keys.privkey_from_type_bytes(name, priv.bytes())
+        assert priv2.pub_key().bytes() == pub.bytes()
+
+
+def test_mixed_batch_verifier_routes_by_type():
+    """BASELINE config 4 shape: a commit with mixed ed25519/sr25519/secp256k1
+    signers batches the ed25519 majority and scalar-verifies the rest, with
+    order-preserving results."""
+    from tendermint_tpu.crypto import batch as crypto_batch
+
+    items = []
+    expect = []
+    for i in range(30):
+        if i % 5 == 3:
+            priv = sr25519.gen_priv_key(bytes([i]) * 8)
+        elif i % 5 == 4:
+            priv = secp256k1.gen_priv_key(bytes([i]) * 8)
+        else:
+            priv = ed25519.gen_priv_key(bytes([i + 1]) * 32)
+        msg = b"mixed%d" % i
+        sig = priv.sign(msg)
+        if i % 7 == 0:
+            sig = sig[:-2] + bytes([sig[-2] ^ 1]) + sig[-1:]
+            expect.append(False)
+        else:
+            expect.append(True)
+        items.append((priv.pub_key(), msg, sig))
+
+    v = crypto_batch.create_batch_verifier()
+    for pub, msg, sig in items:
+        v.add(pub, msg, sig)
+    all_ok, bitmap = v.verify()
+    assert bitmap == expect
+    assert all_ok == all(expect)
