@@ -231,6 +231,216 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_light(args) -> int:
+    """Light client daemon: track a chain over RPC with verified headers and
+    serve verified light blocks (reference: cmd/tendermint/commands/light.go).
+    """
+    from tendermint_tpu.light import (
+        Client,
+        DBStore,
+        HTTPProvider,
+        TrustOptions,
+    )
+    from tendermint_tpu.store.db import new_db
+    from tendermint_tpu.types.ttime import Time
+
+    root = _home(args)
+    _ensure_dirs(root)
+    chain_id = args.chain_id
+    primary = HTTPProvider(chain_id, args.primary)
+    witnesses = [HTTPProvider(chain_id, w) for w in args.witnesses.split(",") if w]
+    store = DBStore(new_db("sqlite", os.path.join(root, "data", "light.db")))
+    if bool(args.trust_height) != bool(args.trust_hash):
+        # Half an anchor is no anchor: silently falling back to TOFU would
+        # discard the operator's pin (reference light.go requires both).
+        print("error: --trusted-height and --trusted-hash must be given together",
+              file=sys.stderr)
+        return 1
+    if args.trust_height and args.trust_hash:
+        opts = TrustOptions(period_s=args.trust_period, height=args.trust_height,
+                            hash=bytes.fromhex(args.trust_hash))
+    else:
+        # TOFU bootstrap from the primary's latest header
+        lb = primary.light_block(0)
+        opts = TrustOptions(period_s=args.trust_period, height=lb.height,
+                            hash=lb.hash())
+        print(f"Trusting height {lb.height} hash {lb.hash().hex().upper()} (TOFU)")
+    client = Client(chain_id, opts, primary, witnesses, store,
+                    max_clock_drift_s=120.0)
+    print(f"Light client running against {args.primary} "
+          f"(latest trusted: {client.latest_trusted.height})")
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    while not stop:
+        try:
+            lb = client.update(Time.now())
+            if lb is not None:
+                print(f"verified height {lb.height} "
+                      f"hash {lb.hash().hex().upper()[:16]}...")
+        except Exception as e:  # noqa: BLE001
+            print(f"update failed: {e}", file=sys.stderr)
+        if args.once:
+            break
+        time.sleep(args.interval)
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """Replay the block store through a fresh app and report the final state
+    (reference: cmd/tendermint/commands/replay.go + consensus/replay_file.go).
+    """
+    from tendermint_tpu.consensus.replay import Handshaker
+    from tendermint_tpu.node.node import default_app
+    from tendermint_tpu.abci.proxy import new_app_conns
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.block_store import BlockStore
+    from tendermint_tpu.store.db import new_db
+    from tendermint_tpu.types.genesis import GenesisDoc
+
+    cfg = _load_config(_home(args))
+    dbdir = cfg.db_dir()
+    block_store = BlockStore(new_db(cfg.base.db_backend,
+                                    os.path.join(dbdir, "blockstore.db")))
+    state_store = StateStore(new_db(cfg.base.db_backend,
+                                    os.path.join(dbdir, "state.db")))
+    genesis = GenesisDoc.from_file(cfg.genesis_file())
+    state = state_store.load()
+    proxy = new_app_conns(default_app(cfg.base.proxy_app))
+    hs = Handshaker(state_store, block_store, genesis)
+    new_state = hs.handshake(state, proxy.consensus)
+    print(f"Replayed to height {new_state.last_block_height} "
+          f"app_hash {new_state.app_hash.hex().upper()}")
+    return 0
+
+
+def cmd_reindex_event(args) -> int:
+    """Rebuild the tx/block index from the block store + stored ABCI
+    responses (reference: cmd/tendermint/commands/reindex_event.go)."""
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.state.txindex import BlockIndexer, TxIndexer
+    from tendermint_tpu.store.block_store import BlockStore
+    from tendermint_tpu.store.db import new_db
+
+    cfg = _load_config(_home(args))
+    dbdir = cfg.db_dir()
+    block_store = BlockStore(new_db(cfg.base.db_backend,
+                                    os.path.join(dbdir, "blockstore.db")))
+    state_store = StateStore(new_db(cfg.base.db_backend,
+                                    os.path.join(dbdir, "state.db")))
+    idx_db = new_db(cfg.base.db_backend, os.path.join(dbdir, "tx_index.db"))
+    txi, bi = TxIndexer(idx_db), BlockIndexer(idx_db)
+    start = args.start_height or block_store.base
+    end = args.end_height or block_store.height
+    n_txs = 0
+    skipped = []
+    for h in range(start, end + 1):
+        block = block_store.load_block(h)
+        if block is None:
+            continue
+        try:
+            resp = state_store.load_abci_responses(h)
+        except Exception:  # noqa: BLE001 - pruned responses
+            # Never index fabricated results (the reference aborts here);
+            # skip the height and tell the operator.
+            skipped.append(h)
+            continue
+        deliver = resp.deliver_txs
+        for i, tx in enumerate(block.data.txs):
+            if i >= len(deliver):
+                break
+            txi.index(h, i, tx, deliver[i])
+            n_txs += 1
+        bi.index(h, resp.begin_block.events if resp.begin_block else [],
+                 resp.end_block.events if resp.end_block else [])
+    print(f"Reindexed heights {start}..{end}: {n_txs} txs"
+          + (f"; skipped {len(skipped)} heights with pruned ABCI responses"
+             if skipped else ""))
+    return 0
+
+
+def cmd_compact(args) -> int:
+    """Compact the sqlite databases (reference:
+    cmd/tendermint/commands/compact.go for goleveldb)."""
+    import sqlite3
+
+    cfg = _load_config(_home(args))
+    if cfg.base.db_backend != "sqlite":
+        print(f"nothing to compact for backend {cfg.base.db_backend!r}")
+        return 0
+    for name in os.listdir(cfg.db_dir()):
+        if not name.endswith(".db"):
+            continue
+        path = os.path.join(cfg.db_dir(), name)
+        before = os.path.getsize(path)
+        conn = sqlite3.connect(path)
+        conn.execute("VACUUM")
+        conn.close()
+        print(f"compacted {name}: {before} -> {os.path.getsize(path)} bytes")
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """Dump node state for debugging (reference:
+    cmd/tendermint/commands/debug/dump.go): config, stores summary, and
+    (when the node is running) /status + /dump_consensus_state via RPC."""
+    import urllib.request
+
+    cfg = _load_config(_home(args))
+    out_dir = args.output or os.path.join(_home(args), "debug")
+    os.makedirs(out_dir, exist_ok=True)
+    doc = {"home": _home(args), "db_backend": cfg.base.db_backend}
+    try:
+        from tendermint_tpu.store.block_store import BlockStore
+        from tendermint_tpu.store.db import new_db
+
+        bs = BlockStore(new_db(cfg.base.db_backend,
+                               os.path.join(cfg.db_dir(), "blockstore.db")))
+        doc["block_store"] = {"base": bs.base, "height": bs.height}
+    except Exception as e:  # noqa: BLE001
+        doc["block_store"] = {"error": str(e)}
+    if args.rpc_laddr:
+        base = "http://" + args.rpc_laddr.split("://", 1)[-1]
+        for method in ("status", "dump_consensus_state", "net_info"):
+            try:
+                body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                                   "params": {}}).encode()
+                with urllib.request.urlopen(urllib.request.Request(
+                        base, data=body,
+                        headers={"Content-Type": "application/json"}),
+                        timeout=5) as r:
+                    doc[method] = json.loads(r.read()).get("result")
+            except Exception as e:  # noqa: BLE001
+                doc[method] = {"error": str(e)}
+    path = os.path.join(out_dir, "dump.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, default=str)
+    print(f"wrote {path}")
+    return 0
+
+
+def cmd_abci_server(args) -> int:
+    """Run the kvstore app behind an ABCI socket (reference:
+    abci/cmd/abci-cli: kvstore subcommand)."""
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.abci.server import ABCIServer
+    from tendermint_tpu.store.db import new_db
+
+    db = new_db("sqlite", args.db) if args.db else None
+    app = KVStoreApplication(db, snapshot_interval=args.snapshot_interval)
+    server = ABCIServer(app, args.address)
+    server.start()
+    print(f"ABCI kvstore server listening on {server.addr}")
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        server.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tendermint-tpu")
     p.add_argument("--home", default=None, help="node home directory")
@@ -263,6 +473,40 @@ def main(argv=None) -> int:
     sp.add_argument("--chain-id", default="")
     sp.add_argument("--starting-port", type=int, default=26656)
     sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("light", help="run a light client daemon")
+    sp.add_argument("chain_id")
+    sp.add_argument("--primary", "-p", required=True, help="primary RPC address")
+    sp.add_argument("--witnesses", "-w", default="", help="comma-separated witness RPC addresses")
+    sp.add_argument("--trusted-height", dest="trust_height", type=int, default=0)
+    sp.add_argument("--trusted-hash", dest="trust_hash", default="")
+    sp.add_argument("--trust-period", dest="trust_period", type=float,
+                    default=168 * 3600.0)
+    sp.add_argument("--interval", type=float, default=1.0)
+    sp.add_argument("--once", action="store_true", help="single update then exit")
+    sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser("replay", help="replay the block store through the app")
+    sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser("reindex-event", help="rebuild the tx/block index")
+    sp.add_argument("--start-height", type=int, default=0)
+    sp.add_argument("--end-height", type=int, default=0)
+    sp.set_defaults(fn=cmd_reindex_event)
+
+    sp = sub.add_parser("compact", help="compact the node databases")
+    sp.set_defaults(fn=cmd_compact)
+
+    sp = sub.add_parser("debug", help="dump node state for debugging")
+    sp.add_argument("--output", default="")
+    sp.add_argument("--rpc-laddr", default="", help="running node RPC to query")
+    sp.set_defaults(fn=cmd_debug)
+
+    sp = sub.add_parser("abci-server", help="run the kvstore app behind a socket")
+    sp.add_argument("--address", default="tcp://127.0.0.1:26658")
+    sp.add_argument("--db", default="", help="sqlite path for persistence")
+    sp.add_argument("--snapshot-interval", type=int, default=0)
+    sp.set_defaults(fn=cmd_abci_server)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,55 @@
+"""Byzantine misbehavior hooks for adversarial testing (reference:
+test/maverick/consensus/misbehavior.go:16).
+
+Install on a ConsensusState via
+`cs.misbehaviors["prevote"] = double_prevote(node.switch)` BEFORE starting
+the node. These deliberately violate the protocol; honest peers must detect
+the equivocation (DuplicateVoteEvidence) and keep committing as long as the
+byzantine power stays below 1/3.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.consensus.reactor import VOTE_CHANNEL, msg_vote
+from tendermint_tpu.consensus.state_machine import MsgInfo, VoteMessage
+from tendermint_tpu.types.block_id import PartSetHeader
+from tendermint_tpu.types.vote import PREVOTE_TYPE
+
+
+def double_prevote(switch):
+    """Hook factory: sign TWO conflicting prevotes (proposal block + nil)
+    and push BOTH directly to every peer, exactly like the maverick's
+    DoublePrevoteMisbehavior sends over the vote channel (reference:
+    misbehavior.go:93-118).
+
+    Requires a signer without a double-sign guard (MockPV); FilePV would
+    refuse the second signature -- which is itself worth testing.
+    """
+
+    def hook(cs, height: int, round_: int) -> None:
+        rs = cs.rs
+        if rs.proposal_block is None:
+            cs._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
+            return
+        vote_a = cs._sign_vote(PREVOTE_TYPE, rs.proposal_block.hash(),
+                               rs.proposal_block_parts.header())
+        vote_b = cs._sign_vote(PREVOTE_TYPE, b"", PartSetHeader())
+        # Internally track only vote A (adding both would trip our own
+        # conflict detection and panic the node -- byzantine, not suicidal).
+        if vote_a is not None:
+            cs._internal_queue.put(MsgInfo(VoteMessage(vote_a), ""))
+        # Gossip only ever serves votes from our own vote set, so the
+        # equivocating pair must be PUSHED to peers over the wire.
+        with switch._peers_mtx:
+            peers = list(switch.peers.values())
+        for v in (vote_a, vote_b):
+            if v is None:
+                continue
+            for p in peers:
+                p.try_send(VOTE_CHANNEL, msg_vote(v))
+
+    return hook
+
+
+def absent_prevote(cs, height: int, round_: int) -> None:
+    """Never prevote (a silent validator)."""

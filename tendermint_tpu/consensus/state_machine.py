@@ -188,6 +188,11 @@ class ConsensusState:
         self._running = False
         self.replay_mode = False
         self._n_steps = 0
+        # Maverick-style misbehavior hooks for adversarial testing
+        # (reference: test/maverick/consensus/misbehavior.go:16). Key
+        # "prevote" -> fn(cs, height, round) replaces the default prevote
+        # behavior. Production nodes never set this.
+        self.misbehaviors: dict = {}
         # decided-block callback fans (reactor hooks; reference evsw usage)
         self.on_new_round_step = []  # callbacks(rs)
         self.on_vote = []  # callbacks(vote)
@@ -698,6 +703,10 @@ class ConsensusState:
 
     def _do_prevote(self, height: int, round_: int) -> None:
         """reference: consensus/state.go:1252-1284 defaultDoPrevote."""
+        mb = self.misbehaviors.get("prevote")
+        if mb is not None:
+            mb(self, height, round_)
+            return
         rs = self.rs
         if rs.locked_block is not None:
             self._sign_add_vote(PREVOTE_TYPE, rs.locked_block.hash(),

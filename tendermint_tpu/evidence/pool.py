@@ -219,3 +219,7 @@ class EvidencePool:
                 age_ns = state.last_block_time.unix_ns() - ev.time().unix_ns()
                 if age_blocks > params.max_age_num_blocks and age_ns > params.max_age_duration_ns:
                     self._db.delete(k)
+        # Convert buffered conflicting votes into DuplicateVoteEvidence now
+        # that the height's state is persisted (reference: evidence/pool.go
+        # Update -> processConsensusBuffer).
+        self._process_consensus_buffer()

@@ -1,0 +1,178 @@
+"""Adversarial tests: byzantine double-prevote in a 4-node net, reactor
+invalid-message fuzzing, and evil handshakes (reference:
+consensus/byzantine_test.go, test/maverick/consensus/misbehavior.go:16,
+p2p/conn/evil_secret_connection_test.go)."""
+
+import os
+import socket
+import time
+
+from tendermint_tpu.config.config import test_config
+from tendermint_tpu.consensus.misbehavior import double_prevote
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.node.node import Node
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.switch import Transport
+from tendermint_tpu.privval.file_pv import MockPV
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.ttime import Time
+
+
+def _wait(cond, timeout, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _mk_net(tmp_path, n):
+    privs = [ed25519.gen_priv_key(bytes([40 + i]) * 32) for i in range(n)]
+    genesis = GenesisDoc(
+        chain_id="adv-chain", genesis_time=Time(1700002000, 0),
+        validators=[GenesisValidator(b"", p.pub_key(), 10) for p in privs],
+    )
+    nodes = []
+    for i in range(n):
+        cfg = test_config()
+        cfg.set_root(str(tmp_path / f"n{i}"))
+        os.makedirs(cfg.base.root_dir, exist_ok=True)
+        cfg.base.fast_sync_mode = False
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.pex = False
+        cfg.rpc.laddr = ""
+        cfg.consensus.wal_path = ""
+        nodes.append(Node(cfg, genesis=genesis, priv_validator=MockPV(privs[i]),
+                          node_key=NodeKey(ed25519.gen_priv_key(bytes([80 + i]) * 32))))
+    return nodes
+
+
+def _connect_all(nodes):
+    for i, a in enumerate(nodes):
+        for b in nodes[i + 1:]:
+            b.switch.dial_peer(a.p2p_addr())
+
+
+def test_byzantine_double_prevote_net_still_commits(tmp_path):
+    """One of four validators equivocates every prevote; the net must keep
+    committing (byz power 1/4 < 1/3) and honest nodes must capture
+    DuplicateVoteEvidence (reference: consensus/byzantine_test.go)."""
+    nodes = _mk_net(tmp_path, 4)
+    byz, honest = nodes[0], nodes[1:]
+    byz.consensus.misbehaviors["prevote"] = double_prevote(byz.switch)
+    for n in nodes:
+        n.start()
+    try:
+        _connect_all(nodes)
+        assert _wait(lambda: all(n.block_store.height >= 3 for n in honest), 90), (
+            [n.block_store.height for n in nodes])
+        # chain identity across honest nodes
+        h1 = [n.block_store.load_block(2).hash() for n in honest]
+        assert len(set(h1)) == 1
+
+        # equivocation detected somewhere: evidence pool or committed block
+        def evidence_seen():
+            for n in honest:
+                if any(isinstance(e, DuplicateVoteEvidence)
+                       for e in n.evidence_pool.pending_evidence(1 << 20)[0]):
+                    return True
+                for h in range(1, n.block_store.height + 1):
+                    b = n.block_store.load_block(h)
+                    if b and any(isinstance(e, DuplicateVoteEvidence)
+                                 for e in b.evidence):
+                        return True
+            return False
+        assert _wait(evidence_seen, 60)
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_reactor_invalid_message_fuzzing(tmp_path):
+    """A handshaked peer spraying garbage on every channel must never kill
+    the node: the peer is dropped or ignored and consensus keeps going."""
+    nodes = _mk_net(tmp_path, 2)
+    for n in nodes:
+        n.start()
+    try:
+        _connect_all(nodes)
+        assert _wait(lambda: nodes[0].block_store.height >= 2, 60)
+
+        # evil client: real transport handshake, then garbage everywhere
+        evil_key = NodeKey(ed25519.gen_priv_key(b"\x66" * 32))
+        info = NodeInfo(node_id=evil_key.id(), network="adv-chain",
+                        moniker="evil")
+        info.channels = bytes([0x00, 0x20, 0x21, 0x22, 0x23, 0x30, 0x38,
+                               0x40, 0x60, 0x61])
+        transport = Transport(evil_key, info)
+        conn, peer_info, _ = transport.dial(nodes[0].p2p_addr())
+        from tendermint_tpu.p2p.connection import ChannelDescriptor, MConnection
+
+        got = []
+        mconn = MConnection(
+            conn,
+            [ChannelDescriptor(c, priority=1) for c in info.channels],
+            on_receive=lambda ch, msg: got.append((ch, msg)),
+            on_error=lambda e: got.append(("err", e)),
+        )
+        mconn.start()
+        import random
+
+        rng = random.Random(1)
+        for ch in info.channels:
+            for _ in range(10):
+                junk = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 200)))
+                if not mconn.send(ch, junk):
+                    break
+            time.sleep(0.02)
+        time.sleep(1.0)
+        mconn.stop()
+
+        # the node survived and still commits
+        h = nodes[0].block_store.height
+        assert _wait(lambda: nodes[0].block_store.height >= h + 2, 60)
+        for name, t in [(x.name, x) for x in __import__("threading").enumerate()]:
+            assert "consensus" not in name or t.is_alive()
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_evil_handshake_garbage_and_slam(tmp_path):
+    """Raw-socket garbage during the secret handshake + connect/slam loops
+    must not crash the accept path (reference:
+    p2p/conn/evil_secret_connection_test.go)."""
+    nodes = _mk_net(tmp_path, 2)
+    for n in nodes:
+        n.start()
+    try:
+        _connect_all(nodes)
+        addr = nodes[0].transport.node_info.listen_addr.split("://", 1)[1]
+        host, port = addr.rsplit(":", 1)
+        for payload in (b"", b"\x00" * 64, b"\xff" * 1024, b"GET / HTTP/1.1\r\n\r\n",
+                        os.urandom(333)):
+            try:
+                s = socket.create_connection((host, int(port)), timeout=2)
+                if payload:
+                    s.sendall(payload)
+                time.sleep(0.05)
+                s.close()
+            except OSError:
+                pass
+        # half-open: connect and vanish without closing politely
+        socks = []
+        for _ in range(5):
+            try:
+                socks.append(socket.create_connection((host, int(port)), timeout=2))
+            except OSError:
+                pass
+        h = nodes[0].block_store.height
+        assert _wait(lambda: nodes[0].block_store.height >= h + 2, 60)
+        for s in socks:
+            s.close()
+    finally:
+        for n in nodes:
+            n.stop()
