@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from tendermint_tpu.consensus import cstypes
 from tendermint_tpu.consensus.state_machine import ConsensusState
 from tendermint_tpu.encoding import proto
+from tendermint_tpu.utils.bits import BitArray
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.switch import Peer, Reactor
 from tendermint_tpu.types.block_id import BlockID, PartSetHeader
@@ -34,36 +35,15 @@ VOTE_SET_BITS_CHANNEL = 0x23
 # --- bit array wire helpers (proto/tendermint/libs/bits/types.proto) --------
 
 
-def bits_marshal(bits: list[bool]) -> bytes:
-    elems = []
-    for i in range(0, len(bits), 64):
-        word = 0
-        for j, b in enumerate(bits[i : i + 64]):
-            if b:
-                word |= 1 << j
-        elems.append(word)
-    w = proto.Writer().varint(1, len(bits))
-    w.packed_varints(2, elems)
-    return w.out()
+def bits_marshal(bits) -> bytes:
+    """Any iterable of bools or a BitArray -> proto bits encoding."""
+    if not isinstance(bits, BitArray):
+        bits = BitArray.from_bools(list(bits))
+    return bits.marshal()
 
 
-def bits_unmarshal(buf: bytes) -> list[bool]:
-    f = proto.fields(buf)
-    n = proto.as_sint64(f.get(1, [0])[-1])
-    elems = []
-    for raw in f.get(2, []):
-        if isinstance(raw, bytes):  # packed
-            pos = 0
-            while pos < len(raw):
-                v, pos = proto.decode_uvarint(raw, pos)
-                elems.append(v)
-        else:
-            elems.append(raw)
-    out = []
-    for i in range(n):
-        word = elems[i // 64] if i // 64 < len(elems) else 0
-        out.append(bool((word >> (i % 64)) & 1))
-    return out
+def bits_unmarshal(buf: bytes) -> BitArray:
+    return BitArray.unmarshal(buf)
 
 
 # --- message codecs ----------------------------------------------------------
@@ -125,14 +105,14 @@ class PeerRoundState:
     step: int = 0
     proposal: bool = False
     proposal_block_psh: PartSetHeader | None = None
-    proposal_block_parts: list[bool] = field(default_factory=list)
+    proposal_block_parts: BitArray = field(default_factory=BitArray)
     proposal_pol_round: int = -1
-    prevotes: dict[int, list[bool]] = field(default_factory=dict)      # round -> bits
-    precommits: dict[int, list[bool]] = field(default_factory=dict)
+    prevotes: dict[int, BitArray] = field(default_factory=dict)      # round -> bits
+    precommits: dict[int, BitArray] = field(default_factory=dict)
     last_commit_round: int = -1
-    last_commit: list[bool] = field(default_factory=list)
+    last_commit: BitArray = field(default_factory=BitArray)
     catchup_commit_round: int = -1
-    catchup_commit: list[bool] = field(default_factory=list)
+    catchup_commit: BitArray = field(default_factory=BitArray)
 
 
 class PeerState:
@@ -149,19 +129,19 @@ class PeerState:
             if prs.height != height or prs.round != round_:
                 prs.proposal = False
                 prs.proposal_block_psh = None
-                prs.proposal_block_parts = []
+                prs.proposal_block_parts = BitArray()
                 prs.proposal_pol_round = -1
             if prs.height != height:
                 if prs.height + 1 == height and prs.round == last_commit_round:
                     prs.last_commit_round = last_commit_round
-                    prs.last_commit = prs.precommits.get(last_commit_round, [])
+                    prs.last_commit = prs.precommits.get(last_commit_round, BitArray())
                 else:
                     prs.last_commit_round = last_commit_round
-                    prs.last_commit = []
+                    prs.last_commit = BitArray()
                 prs.prevotes = {}
                 prs.precommits = {}
                 prs.catchup_commit_round = -1
-                prs.catchup_commit = []
+                prs.catchup_commit = BitArray()
             prs.height = height
             prs.round = round_
             prs.step = step
@@ -177,7 +157,7 @@ class PeerState:
             prs.proposal = True
             if not prs.proposal_block_parts:  # otherwise NewValidBlock set it
                 prs.proposal_block_psh = proposal.block_id.part_set_header
-                prs.proposal_block_parts = [False] * proposal.block_id.part_set_header.total
+                prs.proposal_block_parts = BitArray(proposal.block_id.part_set_header.total)
             prs.proposal_pol_round = proposal.pol_round
 
     def set_has_block_part(self, height, round_, index) -> None:
@@ -194,17 +174,17 @@ class PeerState:
             if bits is not None and 0 <= index < len(bits):
                 bits[index] = True
 
-    def _votes_bits(self, height, round_, type_, n_vals) -> list[bool] | None:
+    def _votes_bits(self, height, round_, type_, n_vals) -> BitArray | None:
         prs = self.prs
         if prs.height == height:
             table = prs.prevotes if type_ == PREVOTE_TYPE else prs.precommits
             if round_ not in table and round_ in (prs.round, prs.round + 1,
                                                  prs.catchup_commit_round):
-                table[round_] = [False] * n_vals
+                table[round_] = BitArray(n_vals)
             return table.get(round_)
         if prs.height == height + 1 and type_ == PRECOMMIT_TYPE and round_ == prs.last_commit_round:
             if not prs.last_commit:
-                prs.last_commit = [False] * n_vals
+                prs.last_commit = BitArray(n_vals)
             return prs.last_commit
         return None
 
@@ -447,7 +427,7 @@ class ConsensusReactor(Reactor):
         with ps.mtx:
             if prs.proposal_block_psh != meta.block_id.part_set_header:
                 prs.proposal_block_psh = meta.block_id.part_set_header
-                prs.proposal_block_parts = [False] * meta.block_id.part_set_header.total
+                prs.proposal_block_parts = BitArray(meta.block_id.part_set_header.total)
             want = [i for i, have in enumerate(prs.proposal_block_parts) if not have]
         if not want:
             time.sleep(self.cs.config.peer_gossip_sleep_duration_s)

@@ -152,7 +152,9 @@ class Node:
                                    config.p2p.dial_timeout_s)
         self.switch = Switch(self.transport, logger=logger,
                              max_inbound=config.p2p.max_num_inbound_peers,
-                             max_outbound=config.p2p.max_num_outbound_peers)
+                             max_outbound=config.p2p.max_num_outbound_peers,
+                             send_rate=config.p2p.send_rate,
+                             recv_rate=config.p2p.recv_rate)
 
         # state sync runs only on a fresh node (reference: node.go:991
         # startStateSync is gated on state.LastBlockHeight == 0)
@@ -287,6 +289,11 @@ class Node:
 
             self.rpc_server = RPCServer(self)
             self.rpc_server.start(self.config.rpc.laddr)
+        if self.config.rpc.grpc_laddr:
+            from tendermint_tpu.rpc.grpc_server import BroadcastAPIServer
+
+            self.grpc_server = BroadcastAPIServer(self, self.config.rpc.grpc_laddr)
+            self.grpc_server.start()
         # indexer + Prometheus (reference: node/node.go:964,1219)
         if self.indexer_service is not None:
             self.indexer_service.start()
@@ -305,6 +312,8 @@ class Node:
         self._running = False
         if self.rpc_server is not None:
             self.rpc_server.stop()
+        if getattr(self, "grpc_server", None) is not None:
+            self.grpc_server.stop()
         if self.indexer_service is not None:
             self.indexer_service.stop()
         if self.metrics_server is not None:

@@ -18,12 +18,17 @@ import time
 from dataclasses import dataclass, field
 
 from tendermint_tpu.encoding import proto
+from tendermint_tpu.utils.flowrate import Monitor
 
 MAX_PACKET_MSG_PAYLOAD_SIZE = 1024
 PING_INTERVAL_S = 20.0
 PONG_TIMEOUT_S = 45.0
 FLUSH_THROTTLE_S = 0.01
 MAX_MSG_SIZE = 10 * 1024 * 1024
+# reference: config SendRate/RecvRate default 5120000 B/s (connection.go:
+# flow-controlled via libs/flowrate Monitor.Limit)
+DEFAULT_SEND_RATE = 5_120_000
+DEFAULT_RECV_RATE = 5_120_000
 
 
 class MConnectionError(Exception):
@@ -70,7 +75,8 @@ class MConnection:
     """on_receive(ch_id, msg_bytes); on_error(err) when the conn dies."""
 
     def __init__(self, conn, channels: list[ChannelDescriptor], on_receive,
-                 on_error=None):
+                 on_error=None, send_rate: int = DEFAULT_SEND_RATE,
+                 recv_rate: int = DEFAULT_RECV_RATE):
         self._conn = conn
         self._channels = {d.id: _Channel(d) for d in channels}
         self._on_receive = on_receive
@@ -81,6 +87,12 @@ class MConnection:
         self._recv_thread: threading.Thread | None = None
         self._last_recv = time.monotonic()
         self._recv_stream = b""
+        # flow accounting + throttling (reference: connection.go:78
+        # sendMonitor/recvMonitor; Limit() applied in sendSomePacketMsgs)
+        self.send_monitor = Monitor()
+        self.recv_monitor = Monitor()
+        self._send_rate = send_rate
+        self._recv_rate = recv_rate
 
     def start(self) -> None:
         self._running = True
@@ -140,6 +152,11 @@ class MConnection:
                     for c in self._channels.values():
                         c.recently_sent = int(c.recently_sent * 0.8)
                     continue
+                # Rate limit before pulling the packet (reference:
+                # sendSomePacketMsgs -> sendMonitor.Limit(maxPacketMsgSize,
+                # SendRate, true)).
+                self.send_monitor.limit(MAX_PACKET_MSG_PAYLOAD_SIZE,
+                                        self._send_rate, block=True)
                 chunk, eof = ch.next_packet()
                 pm = (
                     proto.Writer()
@@ -148,7 +165,9 @@ class MConnection:
                     .bytes(3, chunk)
                     .out()
                 )
-                self._write_packet(proto.Writer().message(3, pm, always=True).out())
+                packet = proto.Writer().message(3, pm, always=True).out()
+                self._write_packet(packet)
+                self.send_monitor.update(len(packet))
         except Exception as e:  # noqa: BLE001
             self._die(e)
 
@@ -179,6 +198,7 @@ class MConnection:
             if not chunk:
                 raise MConnectionError("connection closed")
             self._recv_stream += chunk
+            self.recv_monitor.update(len(chunk))
         out = self._recv_stream[:n]
         self._recv_stream = self._recv_stream[n:]
         return out

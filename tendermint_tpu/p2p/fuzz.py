@@ -1,0 +1,57 @@
+"""Fuzzed connection wrapper for network-fault testing (reference:
+p2p/fuzz.go FuzzedConnection).
+
+Wraps any read/write/close connection object and injects faults on writes
+and reads according to the configured mode:
+  drop  -- silently discard the payload with probability prob_drop_rw
+  sleep -- delay the op by a random interval up to max_delay_s
+  dead  -- after `die_after_s`, every op raises (a vanished peer)
+
+Used by adversarial tests to prove reactors survive lossy/laggy peers; the
+reference exposes the same knobs via FuzzConnConfig.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+
+class FuzzedConnection:
+    """reference: p2p/fuzz.go:23 FuzzedConnection."""
+
+    def __init__(self, conn, *, prob_drop_rw: float = 0.0,
+                 prob_sleep: float = 0.0, max_delay_s: float = 0.1,
+                 die_after_s: float = 0.0, seed: int | None = None):
+        self._conn = conn
+        self.prob_drop_rw = prob_drop_rw
+        self.prob_sleep = prob_sleep
+        self.max_delay_s = max_delay_s
+        self._die_at = time.monotonic() + die_after_s if die_after_s else None
+        self._rng = random.Random(seed)
+
+    def _fuzz(self) -> bool:
+        """Returns True when the op should be dropped."""
+        if self._die_at is not None and time.monotonic() >= self._die_at:
+            raise ConnectionError("fuzzed connection died")
+        if self.prob_sleep and self._rng.random() < self.prob_sleep:
+            time.sleep(self._rng.random() * self.max_delay_s)
+        return bool(self.prob_drop_rw and self._rng.random() < self.prob_drop_rw)
+
+    def write(self, data: bytes) -> int:
+        if self._fuzz():
+            return len(data)  # silently dropped (reference Write fuzz)
+        return self._conn.write(data)
+
+    def read(self, n: int) -> bytes:
+        if self._fuzz():
+            # A dropped read on a framed/AEAD stream looks like EOF -- the
+            # peer abruptly dying, which is exactly the fault worth testing.
+            return b""
+        return self._conn.read(n)
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def __getattr__(self, name):
+        return getattr(self._conn, name)

@@ -56,7 +56,8 @@ class Peer:
     def __init__(self, conn: SecretConnection, node_info: NodeInfo,
                  channels: list[ChannelDescriptor], on_receive, on_error,
                  outbound: bool, persistent: bool = False,
-                 socket_addr: str = ""):
+                 socket_addr: str = "", send_rate: int = 5_120_000,
+                 recv_rate: int = 5_120_000):
         self.node_info = node_info
         self.outbound = outbound
         self.persistent = persistent
@@ -66,6 +67,7 @@ class Peer:
             conn, channels,
             on_receive=lambda ch, msg: on_receive(ch, self, msg),
             on_error=lambda err: on_error(self, err),
+            send_rate=send_rate, recv_rate=recv_rate,
         )
 
     @property
@@ -162,7 +164,10 @@ class Switch:
     """reference: p2p/switch.go:65."""
 
     def __init__(self, transport: Transport, logger=None,
-                 max_inbound: int = 40, max_outbound: int = 10):
+                 max_inbound: int = 40, max_outbound: int = 10,
+                 send_rate: int = 5_120_000, recv_rate: int = 5_120_000):
+        self.send_rate = send_rate
+        self.recv_rate = recv_rate
         self.transport = transport
         self.reactors: dict[str, Reactor] = {}
         self._channels: list[ChannelDescriptor] = []
@@ -266,7 +271,8 @@ class Switch:
                 conn.close()
                 raise P2PError("duplicate peer")
             peer = Peer(conn, peer_info, self._channels, self._on_receive,
-                        self._on_peer_error, outbound, persistent, socket_addr)
+                        self._on_peer_error, outbound, persistent, socket_addr,
+                        send_rate=self.send_rate, recv_rate=self.recv_rate)
             self.peers[peer.id] = peer
         peer.start()
         for r in self.reactors.values():

@@ -1,0 +1,100 @@
+"""Peer trust metric (reference: p2p/trust/metric.go, store.go).
+
+Tracks per-peer behavior as a weighted mix of recent and historical
+good/bad event ratios:
+
+    value = weight_r * R + weight_h * H * derivative_gain
+
+where R is the current-interval ratio, H a rolling history average, and a
+negative-trend derivative dampens flapping peers (metric.go:120 design
+notes). The store keys metrics by peer ID and prunes on peer removal;
+Switch users ban peers whose value drops below a threshold.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+DEFAULT_INTERVAL_S = 10.0
+MAX_HISTORY = 16
+WEIGHT_R = 0.8
+WEIGHT_H = 0.2
+
+
+class TrustMetric:
+    """reference: p2p/trust/metric.go:63 TrustMetric."""
+
+    def __init__(self, interval_s: float = DEFAULT_INTERVAL_S):
+        self._interval = interval_s
+        self._mtx = threading.Lock()
+        self._good = 0.0
+        self._bad = 0.0
+        self._history: list[float] = []
+        self._interval_start = time.monotonic()
+
+    def good_events(self, n: int = 1) -> None:
+        with self._mtx:
+            self._tick_locked()
+            self._good += n
+
+    def bad_events(self, n: int = 1) -> None:
+        with self._mtx:
+            self._tick_locked()
+            self._bad += n
+
+    def _tick_locked(self) -> None:
+        now = time.monotonic()
+        while now - self._interval_start >= self._interval:
+            self._history.append(self._ratio_locked())
+            if len(self._history) > MAX_HISTORY:
+                self._history.pop(0)
+            self._good = self._bad = 0.0
+            self._interval_start += self._interval
+
+    def _ratio_locked(self) -> float:
+        total = self._good + self._bad
+        return self._good / total if total > 0 else 1.0
+
+    def trust_value(self) -> float:
+        """[0, 1]; 1 = fully trusted (reference TrustValue)."""
+        with self._mtx:
+            self._tick_locked()
+            r = self._ratio_locked()
+            h = (sum(self._history) / len(self._history)
+                 if self._history else r)
+            v = WEIGHT_R * r + WEIGHT_H * h
+            # negative-trend damping: falling ratio vs history drags trust
+            # down faster than it recovers (metric.go derivative term)
+            d = r - h
+            if d < 0:
+                v += WEIGHT_R * d
+            return max(0.0, min(1.0, v))
+
+    def trust_score(self) -> int:
+        """0-100 integer form (reference TrustScore)."""
+        return int(round(self.trust_value() * 100))
+
+
+class TrustMetricStore:
+    """reference: p2p/trust/store.go TrustMetricStore."""
+
+    def __init__(self, interval_s: float = DEFAULT_INTERVAL_S):
+        self._interval = interval_s
+        self._mtx = threading.Lock()
+        self._metrics: dict[str, TrustMetric] = {}
+
+    def get_peer_trust_metric(self, peer_id: str) -> TrustMetric:
+        with self._mtx:
+            m = self._metrics.get(peer_id)
+            if m is None:
+                m = self._metrics[peer_id] = TrustMetric(self._interval)
+            return m
+
+    def peer_disconnected(self, peer_id: str) -> None:
+        with self._mtx:
+            self._metrics.pop(peer_id, None)
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._metrics)

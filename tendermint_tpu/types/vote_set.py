@@ -19,6 +19,7 @@ from tendermint_tpu.crypto import batch as crypto_batch
 from tendermint_tpu.types.block_id import BlockID
 from tendermint_tpu.types.block import Commit, make_commit
 from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.utils.bits import BitArray
 from tendermint_tpu.types.vote import (
     ErrVoteConflictingVotes,
     Vote,
@@ -63,7 +64,7 @@ class VoteSet:
         self.round = round_
         self.signed_msg_type = signed_msg_type
         self.val_set = val_set
-        self.votes_bit_array: list[bool] = [False] * val_set.size()
+        self.votes_bit_array = BitArray(val_set.size())
         self.votes: list[Vote | None] = [None] * val_set.size()
         self.sum = 0
         self.maj23: BlockID | None = None
@@ -274,14 +275,14 @@ class VoteSet:
                 peer_maj23=True, num_validators=self.val_set.size()
             )
 
-    def bit_array(self) -> list[bool]:
-        return list(self.votes_bit_array)
+    def bit_array(self) -> BitArray:
+        return self.votes_bit_array.copy()
 
-    def bit_array_by_block_id(self, block_id: BlockID) -> list[bool] | None:
+    def bit_array_by_block_id(self, block_id: BlockID) -> BitArray | None:
         bv = self.votes_by_block.get(block_id.key())
         if bv is None:
             return None
-        return [v is not None for v in bv.votes]
+        return BitArray.from_bools([v is not None for v in bv.votes])
 
     def has_two_thirds_majority(self) -> bool:
         return self.maj23 is not None

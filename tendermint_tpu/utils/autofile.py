@@ -1,0 +1,101 @@
+"""AutoFile group: size-rotated append-only file set (reference:
+libs/autofile/group.go:54 + autofile.go).
+
+Layout matches the reference: the active chunk is `<base>`, rotated chunks
+are `<base>.000`, `<base>.001`, ... Total size is bounded by
+group_check_duration'd head rotation + max chunk retention. The consensus
+WAL embeds its own variant of this (consensus/wal.py); this is the
+general-purpose util for any append log.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class Group:
+    """reference: libs/autofile/group.go:54."""
+
+    def __init__(self, head_path: str, head_size_limit: int = 10 * 1024 * 1024,
+                 total_size_limit: int = 1024 * 1024 * 1024):
+        self.head_path = head_path
+        self.head_size_limit = head_size_limit
+        self.total_size_limit = total_size_limit
+        os.makedirs(os.path.dirname(head_path) or ".", exist_ok=True)
+        self._mtx = threading.Lock()
+        self._head = open(head_path, "ab")
+
+    # --- naming -------------------------------------------------------------
+
+    def _chunk_path(self, index: int) -> str:
+        return f"{self.head_path}.{index:03d}"
+
+    def chunk_indexes(self) -> list[int]:
+        """Sorted indexes of rotated chunks on disk."""
+        base = os.path.basename(self.head_path)
+        d = os.path.dirname(self.head_path) or "."
+        out = []
+        for name in os.listdir(d):
+            if name.startswith(base + "."):
+                suffix = name[len(base) + 1:]
+                if suffix.isdigit():
+                    out.append(int(suffix))
+        return sorted(out)
+
+    # --- writing ------------------------------------------------------------
+
+    def write(self, data: bytes) -> None:
+        with self._mtx:
+            self._head.write(data)
+            if self._head.tell() >= self.head_size_limit:
+                self._rotate_locked()
+
+    def flush(self, fsync: bool = False) -> None:
+        with self._mtx:
+            self._head.flush()
+            if fsync:
+                os.fsync(self._head.fileno())
+
+    def _rotate_locked(self) -> None:
+        """Head becomes the next numbered chunk (reference: group.go
+        RotateFile)."""
+        self._head.flush()
+        os.fsync(self._head.fileno())
+        self._head.close()
+        idxs = self.chunk_indexes()
+        nxt = (idxs[-1] + 1) if idxs else 0
+        os.rename(self.head_path, self._chunk_path(nxt))
+        self._head = open(self.head_path, "ab")
+        self._enforce_total_limit_locked()
+
+    def _enforce_total_limit_locked(self) -> None:
+        """Drop oldest chunks past the total size limit (reference:
+        group.go checkTotalSizeLimit)."""
+        if self.total_size_limit <= 0:
+            return
+        chunks = self.chunk_indexes()
+        sizes = {i: os.path.getsize(self._chunk_path(i)) for i in chunks}
+        total = sum(sizes.values()) + os.path.getsize(self.head_path)
+        for i in chunks:
+            if total <= self.total_size_limit:
+                break
+            os.unlink(self._chunk_path(i))
+            total -= sizes[i]
+
+    # --- reading ------------------------------------------------------------
+
+    def read_all(self):
+        """Yield the group's bytes in order: oldest chunk first, head last."""
+        with self._mtx:
+            self._head.flush()
+        for i in self.chunk_indexes():
+            with open(self._chunk_path(i), "rb") as f:
+                yield f.read()
+        with open(self.head_path, "rb") as f:
+            yield f.read()
+
+    def close(self) -> None:
+        with self._mtx:
+            self._head.flush()
+            self._head.close()

@@ -1,0 +1,153 @@
+"""L0 utility libs: BitArray set ops + wire round-trip, flowrate monitor
+and limiter, autofile group rotation (reference: libs/bits, libs/flowrate,
+libs/autofile)."""
+
+import random
+import time
+
+from tendermint_tpu.utils.autofile import Group
+from tendermint_tpu.utils.bits import BitArray
+from tendermint_tpu.utils.flowrate import Monitor
+
+
+def test_bitarray_basics_and_setops():
+    ba = BitArray(70)
+    assert len(ba) == 70 and ba.is_empty() and not ba.is_full()
+    ba[3] = True
+    ba[69] = True
+    assert ba[3] and ba[69] and not ba[4]
+    assert ba.sum() == 2
+    assert ba[-1] is True
+    assert ba[0:5] == [False, False, False, True, False]
+    assert str(ba).count("x") == 2
+
+    other = BitArray(70)
+    other[3] = True
+    other[10] = True
+    assert ba.or_(other).sum() == 3
+    assert ba.and_(other).sum() == 1
+    assert ba.sub(other).sum() == 1  # only 69 survives
+    assert ba.not_().sum() == 68
+
+    ba.update(other)
+    assert ba.sum() == 3
+
+    full = BitArray.from_bools([True] * 8)
+    assert full.is_full()
+    idx, ok = ba.pick_random(random.Random(1))
+    assert ok and ba[idx]
+    assert BitArray(0).pick_random() == (0, False)
+
+
+def test_bitarray_wire_roundtrip():
+    for n in (0, 1, 63, 64, 65, 130):
+        ba = BitArray(n)
+        for i in range(0, n, 3):
+            ba[i] = True
+        got = BitArray.unmarshal(ba.marshal())
+        assert got == ba, n
+    # interop with list-of-bools comparison
+    assert BitArray.from_bools([True, False, True]) == [True, False, True]
+
+
+def test_flowrate_monitor_and_limit():
+    m = Monitor(sample_period_s=0.01, ewma_window_s=0.05)
+    for _ in range(20):
+        m.update(1000)
+        time.sleep(0.005)
+    st = m.status()
+    assert st.bytes_total == 20_000
+    assert st.avg_rate > 0 and st.cur_rate > 0
+    assert st.peak_rate >= st.cur_rate * 0.5
+
+    # limiter: at 10KB/s, moving 30KB must take ~3s -- prove it throttles by
+    # checking a tight loop is slowed (use a small amount to keep tests fast)
+    m2 = Monitor(sample_period_s=0.01)
+    t0 = time.monotonic()
+    moved = 0
+    while moved < 3000:
+        n = m2.limit(1000, rate=10_000, block=True)
+        moved += m2.update(n)
+    elapsed = time.monotonic() - t0
+    assert elapsed >= 0.2, elapsed  # 3KB at 10KB/s >= ~0.3s theoretical
+    # unlimited rate never blocks
+    assert m2.limit(10**9, rate=0) == 10**9
+
+
+def test_autofile_group_rotation_and_read(tmp_path):
+    head = str(tmp_path / "wal" / "log")
+    g = Group(head, head_size_limit=100, total_size_limit=350)
+    for i in range(10):
+        g.write(b"%02d" % i * 30)  # 60 bytes each -> rotate every 2 writes
+    g.flush(fsync=True)
+    idxs = g.chunk_indexes()
+    assert idxs, "rotation never happened"
+    # total size enforcement dropped the oldest chunks
+    total = sum(len(c) for c in g.read_all())
+    assert total <= 350 + 120  # limit + one head chunk of slack
+    # data is readable oldest-first and contiguous per chunk
+    blobs = list(g.read_all())
+    assert all(isinstance(b, bytes) for b in blobs)
+    g.close()
+
+    # reopening appends to the same head
+    g2 = Group(head, head_size_limit=100)
+    g2.write(b"reopened")
+    g2.flush()
+    assert b"reopened" in list(g2.read_all())[-1]
+    g2.close()
+
+
+def test_trust_metric_rises_and_falls():
+    from tendermint_tpu.p2p.trust import TrustMetric, TrustMetricStore
+
+    m = TrustMetric(interval_s=0.02)
+    for _ in range(50):
+        m.good_events()
+    assert m.trust_score() >= 90
+    time.sleep(0.05)
+    for _ in range(80):
+        m.bad_events()
+    assert m.trust_value() < 0.5
+    # recovery is slower than decay (negative-trend damping)
+    time.sleep(0.05)
+    for _ in range(10):
+        m.good_events()
+    assert m.trust_value() < 1.0
+
+    store = TrustMetricStore(interval_s=0.02)
+    a = store.get_peer_trust_metric("peerA")
+    assert store.get_peer_trust_metric("peerA") is a
+    assert store.size() == 1
+    store.peer_disconnected("peerA")
+    assert store.size() == 0
+
+
+def test_fuzzed_connection_faults():
+    from tendermint_tpu.p2p.fuzz import FuzzedConnection
+
+    class FakeConn:
+        def __init__(self):
+            self.written = []
+        def write(self, b):
+            self.written.append(b)
+            return len(b)
+        def read(self, n):
+            return b"y" * n
+        def close(self):
+            self.closed = True
+
+    raw = FakeConn()
+    # 100% drop: writes vanish, reads look like EOF
+    fc = FuzzedConnection(raw, prob_drop_rw=1.0, seed=1)
+    assert fc.write(b"x") == 1 and raw.written == []
+    assert fc.read(4) == b""
+    # 0% drop passes through
+    fc2 = FuzzedConnection(FakeConn(), prob_drop_rw=0.0, seed=1)
+    assert fc2.read(3) == b"yyy"
+    # dead connection raises after the deadline
+    fc3 = FuzzedConnection(FakeConn(), die_after_s=0.01, seed=1)
+    time.sleep(0.02)
+    import pytest
+    with pytest.raises(ConnectionError):
+        fc3.write(b"x")

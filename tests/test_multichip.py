@@ -1,0 +1,84 @@
+"""Multi-chip shard path under pytest: the 8-device virtual CPU mesh from
+conftest drives the shard_map verify + psum tally (VERDICT round-2: the
+sharded path had only smoke coverage, no pytest)."""
+
+import jax
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import ed25519 as ref
+from tendermint_tpu.ops import ed25519_batch
+from tendermint_tpu.parallel import batch_shard
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = jax.devices()
+    assert len(devices) == 8, "conftest must provide the 8-device CPU mesh"
+    return batch_shard.make_mesh(devices)
+
+
+def _batch(n, tamper=()):
+    items = []
+    for i in range(n):
+        priv = ref.gen_priv_key(bytes([i % 251 + 1]) * 32)
+        msg = b"mc-%d" % i
+        sig = ref.sign(priv.data, msg)
+        if i in tamper:
+            sig = sig[:-1] + bytes([sig[-1] ^ 1])
+        items.append((priv.pub_key().data, msg, sig))
+    args, _ = ed25519_batch.prepare(items)
+    return items, args
+
+
+def test_sharded_verify_tally_all_valid(mesh):
+    n = 64
+    _, args = _batch(n)
+    power = np.full((args["valid"].shape[0],), 3, dtype=np.int32)
+    for_block = args["valid"].copy()
+    step = batch_shard.sharded_verify_tally(mesh)
+    placed = batch_shard.shard_args(mesh, args, power, for_block)
+    ok, tally, all_ok = step(
+        placed["tab"], placed["h_win"], placed["s_win"], placed["r_y"],
+        placed["r_sign"], placed["valid"], placed["power"], placed["for_block"])
+    ok = np.asarray(ok)
+    assert ok[:n].all()
+    assert int(tally) == 3 * n  # psum across all 8 shards
+    assert bool(all_ok)
+    # result bitmap is actually sharded over the mesh
+    assert len(ok) % 8 == 0
+
+
+def test_sharded_verify_tally_detects_bad_sigs(mesh):
+    n = 64
+    tampered = {5, 23, 60}
+    _, args = _batch(n, tamper=tampered)
+    power = np.ones((args["valid"].shape[0],), dtype=np.int32)
+    for_block = args["valid"].copy()
+    step = batch_shard.sharded_verify_tally(mesh)
+    placed = batch_shard.shard_args(mesh, args, power, for_block)
+    ok, tally, all_ok = step(
+        placed["tab"], placed["h_win"], placed["s_win"], placed["r_y"],
+        placed["r_sign"], placed["valid"], placed["power"], placed["for_block"])
+    ok = np.asarray(ok)
+    for i in range(n):
+        assert ok[i] == (i not in tampered), i
+    assert int(tally) == n - len(tampered)
+    assert not bool(all_ok)
+
+
+def test_sharded_matches_single_device(mesh):
+    """The sharded decision bitmap must be byte-identical to the single-chip
+    jnp kernel over the same prepared batch."""
+    n = 32
+    _, args = _batch(n, tamper={7})
+    single = np.asarray(ed25519_batch._jnp_kernel(
+        args["tab"], args["h_win"], args["s_win"], args["r_y"],
+        args["r_sign"], args["valid"]))
+    power = np.ones((args["valid"].shape[0],), dtype=np.int32)
+    step = batch_shard.sharded_verify_tally(mesh)
+    placed = batch_shard.shard_args(mesh, args, power, args["valid"].copy())
+    ok, _, _ = step(
+        placed["tab"], placed["h_win"], placed["s_win"], placed["r_y"],
+        placed["r_sign"], placed["valid"], placed["power"], placed["for_block"])
+    assert (np.asarray(ok) == single).all()

@@ -170,3 +170,29 @@ def test_websocket_subscription(tmp_path):
         s.close()
     finally:
         node.stop()
+
+
+def test_grpc_broadcast_api(tmp_path):
+    """gRPC BroadcastAPI: Ping + BroadcastTx commit round-trip (reference:
+    rpc/grpc/api.go)."""
+    from tendermint_tpu.rpc.grpc_server import BroadcastAPIClient
+
+    node = _mk_node(tmp_path)
+    node.config.rpc.grpc_laddr = "tcp://127.0.0.1:0"
+    node.start()
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and node.block_store.height < 1:
+            time.sleep(0.1)
+        client = BroadcastAPIClient(node.grpc_server.laddr)
+        assert client.ping()
+        res = client.broadcast_tx(b"grpc=yes")
+        assert res["check_tx"]["code"] == 0
+        assert res["deliver_tx"]["code"] == 0
+        # the tx actually landed in the app
+        q = node.app.query(__import__("tendermint_tpu.abci.types", fromlist=["x"]).RequestQuery(
+            path="", data=b"grpc"))
+        assert q.value == b"yes"
+        client.close()
+    finally:
+        node.stop()
