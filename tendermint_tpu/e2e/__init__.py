@@ -1,0 +1,5 @@
+"""Manifest-driven e2e testnet runner (reference: test/e2e/)."""
+
+from tendermint_tpu.e2e.runner import Manifest, Perturbation, Runner
+
+__all__ = ["Manifest", "Perturbation", "Runner"]

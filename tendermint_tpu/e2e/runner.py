@@ -1,0 +1,250 @@
+"""Manifest-driven multi-process e2e testnet runner (reference: test/e2e/
+runner/: stages setup/start/load/perturb/wait/test/stop; perturbations in
+runner/perturb.go).
+
+Each node is a REAL OS process (`python -m tendermint_tpu.cli start`) with
+durable sqlite stores and a WAL, connected over real TCP — the in-process
+harness can't prove crash recovery or process isolation; this can. A
+manifest describes the topology and a perturbation schedule:
+
+    Manifest(validators=4, target_height=12, load_txs=20,
+             perturbations=[Perturbation(node=3, action="kill",
+                                         at_height=5, revive_after_s=2)])
+
+Actions (reference runner/perturb.go): kill (SIGKILL + restart),
+restart (SIGTERM + restart), pause (SIGSTOP/SIGCONT), disconnect (SIGSTOP
+without revive until revive_after_s).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Perturbation:
+    node: int
+    action: str  # kill | restart | pause
+    at_height: int
+    revive_after_s: float = 1.0
+
+
+@dataclass
+class Manifest:
+    """reference: test/e2e/pkg/manifest.go (subset)."""
+
+    validators: int = 4
+    chain_id: str = ""
+    target_height: int = 10
+    load_txs: int = 10
+    starting_port: int = 0  # 0 -> pick a free range
+    perturbations: list[Perturbation] = field(default_factory=list)
+
+    @staticmethod
+    def from_file(path: str) -> "Manifest":
+        with open(path) as f:
+            doc = json.load(f)
+        perts = [Perturbation(**p) for p in doc.pop("perturbations", [])]
+        return Manifest(perturbations=perts, **doc)
+
+
+def _free_port_base(n_ports: int) -> int:
+    """A base port such that [base, base+n_ports) all bind right now."""
+    import random
+    import socket
+
+    rng = random.Random(os.getpid())
+    for _ in range(50):
+        base = rng.randrange(20000, 60000 - n_ports)
+        socks = []
+        try:
+            for off in range(n_ports):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", base + off))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port range found")
+
+
+class Runner:
+    """reference: test/e2e/runner/main.go stage driver."""
+
+    def __init__(self, manifest: Manifest, workdir: str, logger=None):
+        self.m = manifest
+        self.workdir = os.path.abspath(workdir)
+        self.logger = logger
+        self.procs: dict[int, subprocess.Popen | None] = {}
+        self._paused: set[int] = set()
+        if not self.m.starting_port:
+            self.m.starting_port = _free_port_base(2 * self.m.validators)
+        self.rpc_addrs = {
+            i: f"http://127.0.0.1:{self.m.starting_port + 2 * i + 1}"
+            for i in range(self.m.validators)
+        }
+
+    # --- stages -------------------------------------------------------------
+
+    def setup(self) -> None:
+        from tendermint_tpu.cli.main import main as cli
+
+        rc = cli(["testnet", "--v", str(self.m.validators),
+                  "--output", self.workdir,
+                  "--chain-id", self.m.chain_id or "e2e-chain",
+                  "--starting-port", str(self.m.starting_port)])
+        if rc != 0:
+            raise RuntimeError("testnet setup failed")
+        # default_config already uses the durable sqlite backend, so
+        # kill/restart exercises real recovery; nothing to patch.
+
+    def _spawn(self, i: int) -> subprocess.Popen:
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "TM_TPU_DISABLE_BATCH": os.environ.get("TM_TPU_DISABLE_BATCH", "")}
+        log = open(os.path.join(self.workdir, f"node{i}.log"), "ab")
+        return subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.cli",
+             "--home", os.path.join(self.workdir, f"node{i}"), "start"],
+            stdout=log, stderr=log, env=env)
+
+    def start(self) -> None:
+        for i in range(self.m.validators):
+            self.procs[i] = self._spawn(i)
+
+    def load(self) -> None:
+        """Submit load_txs round-robin over the nodes' RPC (reference:
+        runner/load.go)."""
+        sent = 0
+        deadline = time.monotonic() + 60
+        while sent < self.m.load_txs and time.monotonic() < deadline:
+            node = sent % self.m.validators
+            if node in self._paused or self.procs.get(node) is None:
+                sent += 1
+                continue
+            tx = b"e2e%d=v%d" % (sent, sent)
+            try:
+                self._rpc(node, "broadcast_tx_sync",
+                          {"tx": __import__("base64").b64encode(tx).decode()})
+                sent += 1
+            except Exception:  # noqa: BLE001 - node may still be booting
+                time.sleep(0.3)
+
+    def perturb_and_wait(self, timeout_s: float = 180.0) -> None:
+        """Run the perturbation schedule while waiting for target_height
+        (reference: runner/perturb.go + wait.go)."""
+        pending = sorted(self.m.perturbations, key=lambda p: p.at_height)
+        revive_at: list[tuple[float, int, str]] = []
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            h = self.max_height()
+            while pending and h >= pending[0].at_height:
+                p = pending.pop(0)
+                self._apply(p, revive_at)
+            now = time.monotonic()
+            for t, node, action in list(revive_at):
+                if now >= t:
+                    revive_at.remove((t, node, action))
+                    self._revive(node, action)
+            if h >= self.m.target_height and not pending and not revive_at:
+                return
+            time.sleep(0.3)
+        raise TimeoutError(
+            f"testnet did not reach height {self.m.target_height}: "
+            f"max={self.max_height()}, pending={pending}")
+
+    def _apply(self, p: Perturbation, revive_at: list) -> None:
+        proc = self.procs.get(p.node)
+        if proc is None:
+            return
+        if p.action == "kill":
+            proc.kill()
+            proc.wait()
+            self.procs[p.node] = None
+        elif p.action == "restart":
+            proc.terminate()
+            proc.wait()
+            self.procs[p.node] = None
+        elif p.action == "pause":
+            proc.send_signal(signal.SIGSTOP)
+            self._paused.add(p.node)
+        revive_at.append((time.monotonic() + p.revive_after_s, p.node, p.action))
+
+    def _revive(self, node: int, action: str) -> None:
+        if action in ("kill", "restart"):
+            self.procs[node] = self._spawn(node)
+        elif action == "pause":
+            self.procs[node].send_signal(signal.SIGCONT)
+            self._paused.discard(node)
+
+    # --- checks (reference: test/e2e/tests/) --------------------------------
+
+    def max_height(self) -> int:
+        best = 0
+        for i in range(self.m.validators):
+            try:
+                st = self._rpc(i, "status", {})
+                best = max(best, int(st["sync_info"]["latest_block_height"]))
+            except Exception:  # noqa: BLE001
+                continue
+        return best
+
+    def assert_consistent(self, height: int) -> None:
+        """All reachable nodes agree on the block hash at `height`."""
+        hashes = {}
+        for i in range(self.m.validators):
+            try:
+                b = self._rpc(i, "block", {"height": str(height)})
+                hashes[i] = b["block_id"]["hash"]
+            except Exception:  # noqa: BLE001
+                continue
+        assert len(hashes) >= 2, f"too few reachable nodes: {hashes}"
+        assert len(set(hashes.values())) == 1, f"fork detected: {hashes}"
+
+    def stop(self) -> None:
+        for i, proc in self.procs.items():
+            if proc is None:
+                continue
+            if i in self._paused:
+                proc.send_signal(signal.SIGCONT)
+            proc.terminate()
+        for proc in self.procs.values():
+            if proc is not None:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+    def _rpc(self, node: int, method: str, params: dict):
+        body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                           "params": params}).encode()
+        with urllib.request.urlopen(urllib.request.Request(
+                self.rpc_addrs[node], data=body,
+                headers={"Content-Type": "application/json"}), timeout=5) as r:
+            doc = json.loads(r.read())
+        if doc.get("error"):
+            raise RuntimeError(doc["error"])
+        return doc["result"]
+
+
+def run_manifest(manifest: Manifest, workdir: str) -> None:
+    """All stages end to end (reference: runner/main.go)."""
+    r = Runner(manifest, workdir)
+    r.setup()
+    r.start()
+    try:
+        r.load()
+        r.perturb_and_wait()
+        r.assert_consistent(max(manifest.target_height - 2, 1))
+    finally:
+        r.stop()

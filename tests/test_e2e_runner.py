@@ -1,0 +1,46 @@
+"""Manifest-driven e2e runner: 4 real node PROCESSES over TCP with a
+kill + pause perturbation schedule, load, liveness, and fork check
+(reference: test/e2e/runner, networks/ci.toml shape)."""
+
+import json
+
+import pytest
+
+from tendermint_tpu.e2e import Manifest, Perturbation, Runner
+
+
+def test_e2e_testnet_with_perturbations(tmp_path):
+    m = Manifest(
+        validators=4,
+        chain_id="e2e-ci",
+        target_height=8,
+        load_txs=8,
+        perturbations=[
+            # kill -9 one validator mid-chain; it must recover from disk
+            Perturbation(node=3, action="kill", at_height=3, revive_after_s=1.0),
+            # freeze another briefly; 3 of 4 keep committing
+            Perturbation(node=2, action="pause", at_height=5, revive_after_s=2.0),
+        ],
+    )
+    r = Runner(m, str(tmp_path / "net"))
+    r.setup()
+    r.start()
+    try:
+        r.load()
+        r.perturb_and_wait(timeout_s=240)
+        assert r.max_height() >= m.target_height
+        r.assert_consistent(m.target_height - 2)
+    finally:
+        r.stop()
+
+
+def test_manifest_from_file(tmp_path):
+    path = tmp_path / "manifest.json"
+    path.write_text(json.dumps({
+        "validators": 5, "target_height": 20, "load_txs": 3,
+        "perturbations": [{"node": 1, "action": "restart", "at_height": 4}],
+    }))
+    m = Manifest.from_file(str(path))
+    assert m.validators == 5
+    assert m.perturbations[0].action == "restart"
+    assert m.perturbations[0].revive_after_s == 1.0
