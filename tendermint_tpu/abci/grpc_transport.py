@@ -1,0 +1,198 @@
+"""ABCI over gRPC (reference: abci/client/grpc_client.go,
+abci/server/grpc_server.go; service tendermint.abci.ABCIApplication).
+
+Reuses the oneof codec from abci/wire.py: each gRPC method carries the BARE
+Request*/Response* message, which is exactly the payload of the
+corresponding oneof field, so encoding = wrap-with-field-number +
+strip-wrapper. No generated stubs; a protoc-built Go client speaks to this
+server unchanged.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+
+import grpc
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci import wire
+from tendermint_tpu.encoding import proto
+
+SERVICE = "tendermint.abci.ABCIApplication"
+
+# method name -> (wire kind, request oneof field, response oneof field)
+_METHODS = {
+    "Echo": (wire.ECHO, 1, 2),
+    "Flush": (wire.FLUSH, 2, 3),
+    "Info": ("info", 3, 4),
+    "SetOption": ("set_option", 4, 5),
+    "InitChain": ("init_chain", 5, 6),
+    "Query": ("query", 6, 7),
+    "BeginBlock": ("begin_block", 7, 8),
+    "CheckTx": ("check_tx", 8, 9),
+    "DeliverTx": ("deliver_tx", 9, 10),
+    "EndBlock": ("end_block", 10, 11),
+    "Commit": (wire.COMMIT, 11, 12),
+    "ListSnapshots": ("list_snapshots", 12, 13),
+    "OfferSnapshot": ("offer_snapshot", 13, 14),
+    "LoadSnapshotChunk": ("load_snapshot_chunk", 14, 15),
+    "ApplySnapshotChunk": ("apply_snapshot_chunk", 15, 16),
+}
+
+
+def _req_to_inner(kind: str, field: int, req) -> bytes:
+    buf = wire.encode_request(kind, req)
+    return proto.fields(buf).get(field, [b""])[-1]
+
+
+def _inner_to_req(kind: str, field: int, inner: bytes):
+    wrapped = proto.Writer().message(field, inner, always=True).out()
+    return wire.decode_request(wrapped)[1]
+
+
+def _resp_to_inner(kind: str, field: int, resp) -> bytes:
+    buf = wire.encode_response(kind, resp)
+    return proto.fields(buf).get(field, [b""])[-1]
+
+
+def _inner_to_resp(kind: str, field: int, inner: bytes):
+    wrapped = proto.Writer().message(field, inner, always=True).out()
+    return wire.decode_response(wrapped)[1]
+
+
+class ABCIGrpcServer:
+    """reference: abci/server/grpc_server.go."""
+
+    def __init__(self, app: abci.Application, addr: str, max_workers: int = 4):
+        import threading
+
+        self._app = app
+        self._app_mtx = threading.Lock()  # serialize like the socket server
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers))
+        self._server.add_generic_rpc_handlers((self._handler(),))
+        host_port = addr.split("://", 1)[-1]
+        port = self._server.add_insecure_port(host_port)
+        self.addr = f"tcp://{host_port.rsplit(':', 1)[0]}:{port}"
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)
+
+    def _dispatch(self, method: str, request: bytes, context) -> bytes:
+        kind, req_field, resp_field = _METHODS[method]
+        try:
+            if kind == wire.ECHO:
+                msg = proto.fields(request).get(1, [b""])[-1].decode()
+                return _resp_to_inner(kind, resp_field, msg)
+            if kind == wire.FLUSH:
+                return b""
+            req = _inner_to_req(kind, req_field, request)
+            with self._app_mtx:
+                if kind == wire.COMMIT:
+                    resp = self._app.commit()
+                elif kind == "set_option":
+                    resp = self._app.set_option(*req)
+                else:
+                    resp = getattr(self._app, kind)(req)
+            return _resp_to_inner(kind, resp_field, resp)
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            return b""
+
+    def _handler(self):
+        dispatch = self._dispatch
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, hcd):
+                parts = hcd.method.lstrip("/").split("/")
+                if len(parts) != 2 or parts[0] != SERVICE or parts[1] not in _METHODS:
+                    return None
+                name = parts[1]
+                return grpc.unary_unary_rpc_method_handler(
+                    lambda request, context: dispatch(name, request, context),
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                )
+
+        return Handler()
+
+
+class ABCIGrpcClient:
+    """Application surface over gRPC -- drop-in like ABCISocketClient
+    (reference: abci/client/grpc_client.go)."""
+
+    def __init__(self, addr: str, timeout_s: float = 10.0):
+        self.timeout_s = timeout_s
+        self._channel = grpc.insecure_channel(addr.split("://", 1)[-1])
+        self._calls = {
+            name: self._channel.unary_unary(
+                f"/{SERVICE}/{name}",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+            for name in _METHODS
+        }
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def _call(self, method: str, req=None):
+        kind, req_field, resp_field = _METHODS[method]
+        if kind == wire.ECHO:
+            inner = proto.Writer().string(1, req or "").out()
+        elif req is None:
+            inner = b""
+        else:
+            inner = _req_to_inner(kind, req_field, req)
+        raw = self._calls[method](inner, timeout=self.timeout_s)
+        if kind == wire.FLUSH:
+            return None
+        if kind == wire.ECHO:
+            return proto.fields(raw).get(1, [b""])[-1].decode() if raw else ""
+        return _inner_to_resp(kind, resp_field, raw)
+
+    def echo(self, msg: str) -> str:
+        return self._call("Echo", msg)
+
+    def flush(self) -> None:
+        self._call("Flush")
+
+    def info(self, req):
+        return self._call("Info", req)
+
+    def set_option(self, key, value):
+        return self._call("SetOption", (key, value))
+
+    def query(self, req):
+        return self._call("Query", req)
+
+    def check_tx(self, req):
+        return self._call("CheckTx", req)
+
+    def init_chain(self, req):
+        return self._call("InitChain", req)
+
+    def begin_block(self, req):
+        return self._call("BeginBlock", req)
+
+    def deliver_tx(self, req):
+        return self._call("DeliverTx", req)
+
+    def end_block(self, req):
+        return self._call("EndBlock", req)
+
+    def commit(self):
+        return self._call("Commit")
+
+    def list_snapshots(self, req):
+        return self._call("ListSnapshots", req)
+
+    def offer_snapshot(self, req):
+        return self._call("OfferSnapshot", req)
+
+    def load_snapshot_chunk(self, req):
+        return self._call("LoadSnapshotChunk", req)
+
+    def apply_snapshot_chunk(self, req):
+        return self._call("ApplySnapshotChunk", req)

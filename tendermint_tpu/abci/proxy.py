@@ -93,8 +93,24 @@ def socket_app_conns(addr: str, timeout_s: float = 10.0) -> AppConns:
     )
 
 
+def grpc_app_conns(addr: str, timeout_s: float = 10.0) -> AppConns:
+    """Four independent gRPC channels to one app server (reference:
+    proxy/client.go grpc transport)."""
+    from tendermint_tpu.abci.grpc_transport import ABCIGrpcClient
+
+    return AppConns(
+        consensus=ABCIGrpcClient(addr, timeout_s),
+        mempool=ABCIGrpcClient(addr, timeout_s),
+        query=ABCIGrpcClient(addr, timeout_s),
+        snapshot=ABCIGrpcClient(addr, timeout_s),
+    )
+
+
 def new_app_conns(app_or_addr) -> AppConns:
-    """In-proc Application object or a tcp://|unix:// address string."""
+    """In-proc Application object, or a tcp://|unix:// (socket) or grpc://
+    address string."""
     if isinstance(app_or_addr, str):
+        if app_or_addr.startswith("grpc://"):
+            return grpc_app_conns(app_or_addr)
         return socket_app_conns(app_or_addr)
     return local_app_conns(app_or_addr)

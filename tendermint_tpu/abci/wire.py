@@ -196,6 +196,10 @@ def encode_request(kind: str, req=None) -> bytes:
         inner = (proto.Writer().uvarint(1, req.index).bytes(2, req.chunk)
                  .string(3, req.sender).out())
         w.message(15, inner, always=True)
+    elif kind == "set_option":
+        key, value = req
+        inner = proto.Writer().string(1, key).string(2, value).out()
+        w.message(4, inner, always=True)
     else:
         raise ValueError(f"unknown request kind {kind!r}")
     return w.out()

@@ -543,6 +543,17 @@ class ConsensusState:
                 _time.time_ns(),
             )
         self._n_steps += 1
+        # step-duration tracing (utils/trace; no-op unless trace.enable())
+        from tendermint_tpu.utils import trace as _trace
+
+        if _trace.enabled():
+            now = _time.monotonic()
+            last = getattr(self, "_last_step_at", None)
+            if last is not None:
+                _trace.record("consensus.step", now - last,
+                              height=self.rs.height, round=self.rs.round,
+                              step=self.rs.step)
+            self._last_step_at = now
         self.event_bus.publish_event_new_round_step(self._round_state_event())
         for cb in self.on_new_round_step:
             cb(self.rs)

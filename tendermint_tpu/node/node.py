@@ -30,8 +30,8 @@ def default_app(name: str):
     """App selection (reference: proxy/client.go:75 DefaultClientCreator):
     a known in-proc app name, or a tcp://|unix:// address of an out-of-process
     ABCI socket server."""
-    if name.startswith(("tcp://", "unix://")):
-        return name  # resolved to socket clients by abci.proxy.new_app_conns
+    if name.startswith(("tcp://", "unix://", "grpc://")):
+        return name  # resolved to socket/grpc clients by abci.proxy.new_app_conns
     if name in ("kvstore", "persistent_kvstore"):
         return KVStoreApplication()
     if name == "noop":

@@ -1,0 +1,98 @@
+"""Tracing/profiling harness (SURVEY aux #36).
+
+The reference exposes pprof + Prometheus step histograms; a TPU build also
+needs (a) lightweight host-side span tracing around consensus transitions
+and verify flushes, and (b) a JAX device profiler hook for kernel work.
+
+ - span(name): context manager recording wall-time spans into a bounded
+   in-memory ring (enable() first; disabled spans cost one dict lookup).
+ - jax_profile(dir): wraps jax.profiler.trace when JAX is importable --
+   traces written there open in TensorBoard / xprof.
+ - dump(): drain the ring for RPC debug dumps or test assertions.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+_MAX_SPANS = 4096
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    duration_s: float
+    tags: dict
+
+
+_enabled = False
+_spans: deque = deque(maxlen=_MAX_SPANS)
+_mtx = threading.Lock()
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def span(name: str, **tags):
+    if not _enabled:
+        yield
+        return
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        with _mtx:
+            _spans.append(Span(name, t0, time.monotonic() - t0, tags))
+
+
+def record(name: str, duration_s: float, **tags) -> None:
+    """Record an externally-timed span (e.g. a kernel wall time)."""
+    if not _enabled:
+        return
+    with _mtx:
+        _spans.append(Span(name, time.monotonic() - duration_s, duration_s, tags))
+
+
+def dump(clear: bool = False) -> list[Span]:
+    with _mtx:
+        out = list(_spans)
+        if clear:
+            _spans.clear()
+    return out
+
+
+def summarize() -> dict[str, dict]:
+    """name -> {count, total_s, max_s} aggregation."""
+    agg: dict[str, dict] = {}
+    for s in dump():
+        a = agg.setdefault(s.name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+        a["count"] += 1
+        a["total_s"] += s.duration_s
+        a["max_s"] = max(a["max_s"], s.duration_s)
+    return agg
+
+
+@contextlib.contextmanager
+def jax_profile(log_dir: str):
+    """Device-side profiling via jax.profiler (xprof traces)."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

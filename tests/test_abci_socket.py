@@ -220,3 +220,78 @@ def test_consensus_with_app_behind_socket(tmp_path):
             node.stop()
     finally:
         server.stop()
+
+
+def test_abci_grpc_transport_roundtrip():
+    """ABCI over gRPC: every method crosses the channel (reference:
+    abci/client/grpc_client.go, abci/server/grpc_server.go)."""
+    from tendermint_tpu.abci.grpc_transport import ABCIGrpcClient, ABCIGrpcServer
+
+    app = KVStoreApplication(snapshot_interval=1)
+    server = ABCIGrpcServer(app, "tcp://127.0.0.1:0")
+    server.start()
+    try:
+        client = ABCIGrpcClient(server.addr)
+        assert client.echo("grpc-ping") == "grpc-ping"
+        client.flush()
+        assert client.info(abci.RequestInfo()).last_block_height == 0
+        assert client.check_tx(abci.RequestCheckTx(tx=b"g=1")).code == 0
+        client.begin_block(abci.RequestBeginBlock())
+        assert client.deliver_tx(abci.RequestDeliverTx(tx=b"g=1")).code == 0
+        client.end_block(abci.RequestEndBlock(height=1))
+        commit = client.commit()
+        assert commit.data == app.app_hash
+        assert client.query(abci.RequestQuery(path="", data=b"g")).value == b"1"
+        snaps = client.list_snapshots(abci.RequestListSnapshots()).snapshots
+        assert snaps and snaps[0].height == 1
+        chunk = client.load_snapshot_chunk(abci.RequestLoadSnapshotChunk(
+            height=1, format=1, chunk=0)).chunk
+        assert chunk
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_consensus_with_app_behind_grpc(tmp_path):
+    """A node commits blocks with the app remote over gRPC (proxy_app =
+    grpc://...)."""
+    from tendermint_tpu.abci.grpc_transport import ABCIGrpcServer
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.privval.file_pv import MockPV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types.ttime import Time
+
+    app = KVStoreApplication()
+    server = ABCIGrpcServer(app, "tcp://127.0.0.1:0")
+    server.start()
+    try:
+        priv = ed25519.gen_priv_key(b"\x75" * 32)
+        genesis = GenesisDoc(
+            chain_id="grpc-chain", genesis_time=Time(1700003000, 0),
+            validators=[GenesisValidator(b"", priv.pub_key(), 10)],
+        )
+        cfg = test_config()
+        cfg.set_root(str(tmp_path / "node"))
+        os.makedirs(cfg.base.root_dir, exist_ok=True)
+        cfg.base.fast_sync_mode = False
+        cfg.base.proxy_app = "grpc://" + server.addr.split("://", 1)[1]
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = ""
+        cfg.consensus.wal_path = ""
+        node = Node(cfg, genesis=genesis, priv_validator=MockPV(priv),
+                    node_key=NodeKey(ed25519.gen_priv_key(b"\x76" * 32)))
+        node.start()
+        try:
+            node.mempool.check_tx(b"grpctx=1")
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and app.height < 3:
+                time.sleep(0.1)
+            assert app.height >= 3
+            assert app.db.get(b"kv:grpctx") == b"1"
+        finally:
+            node.stop()
+    finally:
+        server.stop()

@@ -151,3 +151,69 @@ def test_fuzzed_connection_faults():
     import pytest
     with pytest.raises(ConnectionError):
         fc3.write(b"x")
+
+
+def test_trace_spans_and_summary():
+    from tendermint_tpu.utils import trace
+
+    trace.disable()
+    with trace.span("noop"):
+        pass
+    assert trace.dump(clear=True) == []
+
+    trace.enable()
+    try:
+        with trace.span("verify", batch=64):
+            time.sleep(0.01)
+        trace.record("kernel", 0.005, chunk=0)
+        spans = trace.dump()
+        names = [s.name for s in spans]
+        assert "verify" in names and "kernel" in names
+        v = next(s for s in spans if s.name == "verify")
+        assert v.duration_s >= 0.01 and v.tags == {"batch": 64}
+        agg = trace.summarize()
+        assert agg["verify"]["count"] == 1
+        assert agg["kernel"]["total_s"] >= 0.005
+    finally:
+        trace.disable()
+        trace.dump(clear=True)
+
+
+def test_trace_consensus_steps(tmp_path):
+    """trace.enable() captures consensus step transitions on a live node."""
+    import os
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.privval.file_pv import MockPV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types.ttime import Time
+    from tendermint_tpu.utils import trace
+
+    priv = ed25519.gen_priv_key(b"\x43" * 32)
+    genesis = GenesisDoc(chain_id="trace-chain", genesis_time=Time(1700003000, 0),
+                         validators=[GenesisValidator(b"", priv.pub_key(), 10)])
+    cfg = test_config()
+    cfg.set_root(str(tmp_path / "n"))
+    os.makedirs(cfg.base.root_dir, exist_ok=True)
+    cfg.base.fast_sync_mode = False
+    cfg.p2p.laddr = ""
+    cfg.p2p.pex = False
+    cfg.rpc.laddr = ""
+    cfg.consensus.wal_path = ""
+    trace.enable()
+    node = Node(cfg, genesis=genesis, priv_validator=MockPV(priv),
+                node_key=NodeKey(ed25519.gen_priv_key(b"\x44" * 32)))
+    node.start()
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and node.block_store.height < 2:
+            time.sleep(0.1)
+        assert node.block_store.height >= 2
+    finally:
+        node.stop()
+        trace.disable()
+    agg = trace.summarize()
+    trace.dump(clear=True)
+    assert agg.get("consensus.step", {}).get("count", 0) >= 5
