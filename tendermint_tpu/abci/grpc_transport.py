@@ -81,6 +81,8 @@ class ABCIGrpcServer:
         self._server.stop(grace=0.5)
 
     def _dispatch(self, method: str, request: bytes, context) -> bytes:
+        from tendermint_tpu.abci.server import _dispatch as app_dispatch
+
         kind, req_field, resp_field = _METHODS[method]
         try:
             if kind == wire.ECHO:
@@ -90,12 +92,7 @@ class ABCIGrpcServer:
                 return b""
             req = _inner_to_req(kind, req_field, request)
             with self._app_mtx:
-                if kind == wire.COMMIT:
-                    resp = self._app.commit()
-                elif kind == "set_option":
-                    resp = self._app.set_option(*req)
-                else:
-                    resp = getattr(self._app, kind)(req)
+                resp = app_dispatch(self._app, kind, req)
             return _resp_to_inner(kind, resp_field, resp)
         except Exception as e:  # noqa: BLE001
             context.abort(grpc.StatusCode.INTERNAL, str(e))
@@ -145,7 +142,14 @@ class ABCIGrpcClient:
             inner = b""
         else:
             inner = _req_to_inner(kind, req_field, req)
-        raw = self._calls[method](inner, timeout=self.timeout_s)
+        try:
+            raw = self._calls[method](inner, timeout=self.timeout_s)
+        except grpc.RpcError as e:
+            # Same error contract as the socket transport: app exceptions
+            # surface as ABCIRemoteError, transport faults stay RpcError.
+            if e.code() == grpc.StatusCode.INTERNAL:
+                raise wire.ABCIRemoteError(e.details()) from e
+            raise
         if kind == wire.FLUSH:
             return None
         if kind == wire.ECHO:

@@ -49,6 +49,7 @@ from tendermint_tpu.types.vote import (
     Vote,
 )
 from tendermint_tpu.types.vote_set import VoteSet
+from tendermint_tpu.utils import trace as _trace
 
 
 class ConsensusError(Exception):
@@ -543,17 +544,19 @@ class ConsensusState:
                 _time.time_ns(),
             )
         self._n_steps += 1
-        # step-duration tracing (utils/trace; no-op unless trace.enable())
-        from tendermint_tpu.utils import trace as _trace
-
-        if _trace.enabled():
-            now = _time.monotonic()
-            last = getattr(self, "_last_step_at", None)
-            if last is not None:
-                _trace.record("consensus.step", now - last,
-                              height=self.rs.height, round=self.rs.round,
-                              step=self.rs.step)
-            self._last_step_at = now
+        # step-duration tracing (no-op beyond the enabled() check + timestamp
+        # bookkeeping; the timestamp/step update is unconditional so a
+        # disable/enable cycle can't produce a span covering the gap)
+        now = _time.monotonic()
+        last = getattr(self, "_last_step_at", None)
+        prev_step = getattr(self, "_last_step_name", None)
+        self._last_step_at = now
+        self._last_step_name = self.rs.step
+        if _trace.enabled() and last is not None and prev_step is not None:
+            # the measured duration belongs to the step we LEFT
+            _trace.record("consensus.step", now - last,
+                          height=self.rs.height, round=self.rs.round,
+                          step=prev_step)
         self.event_bus.publish_event_new_round_step(self._round_state_event())
         for cb in self.on_new_round_step:
             cb(self.rs)

@@ -1,0 +1,79 @@
+"""Peer behaviour reporting (reference: behaviour/reporter.go,
+behaviour/peer_behaviour.go).
+
+Reactors report typed peer behaviours to a single Reporter instead of
+reaching into the Switch directly; the SwitchReporter translates bad
+behaviours into StopPeerForError and good behaviours into addrbook/trust
+credit. MockReporter records for tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+# behaviour kinds (reference: peer_behaviour.go:20-46)
+BAD_MESSAGE = "bad_message"
+MESSAGE_OUT_OF_ORDER = "message_out_of_order"
+CONSENSUS_VOTE = "consensus_vote"
+BLOCK_PART = "block_part"
+
+_GOOD = {CONSENSUS_VOTE, BLOCK_PART}
+
+
+@dataclass(frozen=True)
+class PeerBehaviour:
+    peer_id: str
+    kind: str
+    reason: str = ""
+
+    def is_good(self) -> bool:
+        return self.kind in _GOOD
+
+
+def bad_message(peer_id: str, reason: str) -> PeerBehaviour:
+    return PeerBehaviour(peer_id, BAD_MESSAGE, reason)
+
+
+def message_out_of_order(peer_id: str, reason: str) -> PeerBehaviour:
+    return PeerBehaviour(peer_id, MESSAGE_OUT_OF_ORDER, reason)
+
+
+def consensus_vote(peer_id: str, reason: str = "") -> PeerBehaviour:
+    return PeerBehaviour(peer_id, CONSENSUS_VOTE, reason)
+
+
+def block_part(peer_id: str, reason: str = "") -> PeerBehaviour:
+    return PeerBehaviour(peer_id, BLOCK_PART, reason)
+
+
+class SwitchReporter:
+    """reference: behaviour/reporter.go:20 SwitchReporter."""
+
+    def __init__(self, switch, trust_store=None):
+        self._switch = switch
+        self._trust = trust_store
+
+    def report(self, b: PeerBehaviour) -> None:
+        if self._trust is not None:
+            m = self._trust.get_peer_trust_metric(b.peer_id)
+            (m.good_events if b.is_good() else m.bad_events)()
+        if b.is_good():
+            return
+        self._switch.stop_peer_by_id(b.peer_id, f"{b.kind}: {b.reason}")
+
+
+class MockReporter:
+    """reference: behaviour/reporter.go:47 MockReporter."""
+
+    def __init__(self) -> None:
+        self._mtx = threading.Lock()
+        self._by_peer: dict[str, list[PeerBehaviour]] = {}
+
+    def report(self, b: PeerBehaviour) -> None:
+        with self._mtx:
+            self._by_peer.setdefault(b.peer_id, []).append(b)
+
+    def get_behaviours(self, peer_id: str) -> list[PeerBehaviour]:
+        with self._mtx:
+            return list(self._by_peer.get(peer_id, []))

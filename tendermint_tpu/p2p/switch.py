@@ -294,6 +294,16 @@ class Switch:
     def _on_peer_error(self, peer: Peer, err) -> None:
         self.stop_peer_for_error(peer, err)
 
+    def stop_peer_by_id(self, peer_id: str, reason) -> bool:
+        """Public stop-by-id for behaviour reporters etc.; returns False when
+        the peer is already gone."""
+        with self._peers_mtx:
+            peer = self.peers.get(peer_id)
+        if peer is None:
+            return False
+        self.stop_peer_for_error(peer, reason)
+        return True
+
     def stop_peer_for_error(self, peer: Peer, reason) -> None:
         """reference: p2p/switch.go StopPeerForError."""
         with self._peers_mtx:

@@ -217,3 +217,37 @@ def test_trace_consensus_steps(tmp_path):
     agg = trace.summarize()
     trace.dump(clear=True)
     assert agg.get("consensus.step", {}).get("count", 0) >= 5
+
+
+def test_behaviour_reporter():
+    from tendermint_tpu.p2p.behaviour import (
+        MockReporter,
+        SwitchReporter,
+        bad_message,
+        consensus_vote,
+    )
+    from tendermint_tpu.p2p.trust import TrustMetricStore
+
+    mock = MockReporter()
+    mock.report(consensus_vote("p1"))
+    mock.report(bad_message("p1", "garbage"))
+    bs = mock.get_behaviours("p1")
+    assert [b.kind for b in bs] == ["consensus_vote", "bad_message"]
+    assert not bs[1].is_good() and bs[0].is_good()
+
+    # SwitchReporter: bad behaviour stops the peer, good credits trust
+    class FakeSwitch:
+        def __init__(self):
+            self.stopped = []
+        def stop_peer_by_id(self, peer_id, reason):
+            self.stopped.append(reason)
+            return True
+
+    sw = FakeSwitch()
+    store = TrustMetricStore(interval_s=10)
+    rep = SwitchReporter(sw, trust_store=store)
+    rep.report(consensus_vote("p2"))
+    assert sw.stopped == []
+    rep.report(bad_message("p2", "evil"))
+    assert sw.stopped and "bad_message" in sw.stopped[0]
+    assert store.get_peer_trust_metric("p2").trust_value() < 1.0
