@@ -1,0 +1,283 @@
+"""Fast sync v1: event-driven FSM (reference: blockchain/v1/reactor_fsm.go,
+blockchain/v1/reactor.go).
+
+Same wire protocol and verification as v0 (channel 0x40, VerifyCommitLight
+per block -- one batched kernel call); the difference is structure: instead
+of a polling loop, all input (peer status, block responses, peer removal,
+scheduling ticks) becomes EVENTS consumed by a single FSM routine with
+explicit states:
+
+    unknown -> wait_for_peer -> wait_for_block -> finished
+
+Selected with config.fastsync.version = "v1".
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+
+from tendermint_tpu.blockchain.reactor import (
+    BLOCKCHAIN_CHANNEL,
+    BlockPool,
+    msg_block_request,
+    msg_block_response,
+    msg_no_block_response,
+    msg_status_request,
+    msg_status_response,
+)
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Peer, Reactor
+from tendermint_tpu.types.block import Block
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.part_set import PartSet
+
+# states (reference: reactor_fsm.go:22-28)
+S_UNKNOWN = "unknown"
+S_WAIT_FOR_PEER = "wait_for_peer"
+S_WAIT_FOR_BLOCK = "wait_for_block"
+S_FINISHED = "finished"
+
+NO_PEER_TIMEOUT_S = 15.0  # reference: waitForPeerTimeout
+
+
+@dataclass
+class Ev:
+    """FSM event (reference: reactor_fsm.go bcReactorEvent)."""
+
+    kind: str  # start | status | block | no_block | remove_peer | tick | stop
+    peer_id: str = ""
+    base: int = 0
+    height: int = 0
+    block: Block | None = None
+
+
+class FastSyncFSM:
+    """reference: reactor_fsm.go:118 bcReactorFSM."""
+
+    def __init__(self, reactor: "BlockchainReactorV1"):
+        self.r = reactor
+        self.state = S_UNKNOWN
+        self.started_at = 0.0
+
+    def handle(self, ev: Ev) -> None:
+        if self.state == S_FINISHED:
+            return
+        if ev.kind == "start":
+            self.started_at = time.monotonic()
+            self._to(S_WAIT_FOR_PEER)
+        elif ev.kind == "status":
+            self.r.pool.set_peer_range(ev.peer_id, ev.base, ev.height)
+            if self.state == S_WAIT_FOR_PEER:
+                self._to(S_WAIT_FOR_BLOCK)
+            self.r.make_requests()
+        elif ev.kind == "block":
+            if self.state != S_WAIT_FOR_BLOCK:
+                return
+            self.r.pool.add_block(ev.peer_id, ev.block)
+            self._process_ready()
+        elif ev.kind == "no_block":
+            # peer advertised a height it can't serve: drop it
+            self.r.drop_peer(ev.peer_id, "no block for advertised height")
+        elif ev.kind == "remove_peer":
+            self.r.pool.remove_peer(ev.peer_id)
+            if not self.r.pool.peers and self.state == S_WAIT_FOR_BLOCK:
+                self._to(S_WAIT_FOR_PEER)
+        elif ev.kind == "tick":
+            if (self.state == S_WAIT_FOR_PEER
+                    and time.monotonic() - self.started_at > NO_PEER_TIMEOUT_S
+                    and not self.r.expects_peers()):
+                self._finish()  # solo node: nothing to sync from
+                return
+            if self.state == S_WAIT_FOR_BLOCK:
+                self._process_ready()
+                if self.r.pool.is_caught_up():
+                    self._finish()
+                    return
+            self.r.make_requests()
+
+    def _process_ready(self) -> None:
+        """Apply every contiguously-available verified block (reference:
+        processBlock event handling)."""
+        while True:
+            if not self.r.try_process_block():
+                return
+            if self.r.pool.is_caught_up():
+                self._finish()
+                return
+
+    def _to(self, state: str) -> None:
+        self.state = state
+
+    def _finish(self) -> None:
+        self.state = S_FINISHED
+        self.r.on_finished()
+
+
+class BlockchainReactorV1(Reactor):
+    """reference: blockchain/v1/reactor.go."""
+
+    def __init__(self, state, block_exec, block_store, fast_sync: bool,
+                 consensus_reactor=None, logger=None):
+        super().__init__("BLOCKCHAIN")
+        self.state = state
+        self.initial_state = state
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.fast_sync = fast_sync
+        self.consensus_reactor = consensus_reactor
+        self.logger = logger
+        self.pool = BlockPool(block_store.height + 1)
+        self.fsm = FastSyncFSM(self)
+        self._events: queue.Queue = queue.Queue(maxsize=1000)
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._synced = threading.Event()
+        self._last_status_bcast = 0.0
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(BLOCKCHAIN_CHANNEL, priority=10,
+                                  recv_message_capacity=50 * 1024 * 1024)]
+
+    # --- peer lifecycle ------------------------------------------------------
+
+    def add_peer(self, peer: Peer) -> None:
+        peer.try_send(BLOCKCHAIN_CHANNEL,
+                      msg_status_response(self.block_store.height, self.block_store.base))
+        peer.try_send(BLOCKCHAIN_CHANNEL, msg_status_request())
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        self._post(Ev("remove_peer", peer_id=peer.id))
+
+    def drop_peer(self, peer_id: str, reason: str) -> None:
+        if self.switch is not None:
+            self.switch.stop_peer_by_id(peer_id, reason)
+        self._post(Ev("remove_peer", peer_id=peer_id))
+
+    def expects_peers(self) -> bool:
+        sw = self.switch
+        return bool(sw is not None and (sw.peers or sw._persistent_addrs))
+
+    # --- receive: wire messages -> events ------------------------------------
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        f = proto.fields(msg_bytes)
+        if 1 in f:  # BlockRequest (serving side, no FSM involvement)
+            m = proto.fields(f[1][-1])
+            height = proto.as_sint64(m.get(1, [0])[-1])
+            block = self.block_store.load_block(height)
+            if block is not None:
+                peer.try_send(BLOCKCHAIN_CHANNEL, msg_block_response(block))
+            else:
+                peer.try_send(BLOCKCHAIN_CHANNEL, msg_no_block_response(height))
+        elif 2 in f:  # NoBlockResponse
+            m = proto.fields(f[2][-1])
+            self._post(Ev("no_block", peer_id=peer.id,
+                          height=proto.as_sint64(m.get(1, [0])[-1])))
+        elif 3 in f:  # BlockResponse
+            m = proto.fields(f[3][-1])
+            self._post(Ev("block", peer_id=peer.id,
+                          block=Block.unmarshal(m.get(1, [b""])[-1])))
+        elif 4 in f:  # StatusRequest
+            peer.try_send(BLOCKCHAIN_CHANNEL,
+                          msg_status_response(self.block_store.height, self.block_store.base))
+        elif 5 in f:  # StatusResponse
+            m = proto.fields(f[5][-1])
+            self._post(Ev("status", peer_id=peer.id,
+                          base=proto.as_sint64(m.get(2, [0])[-1]),
+                          height=proto.as_sint64(m.get(1, [0])[-1])))
+
+    def _post(self, ev: Ev) -> None:
+        try:
+            self._events.put_nowait(ev)
+        except queue.Full:
+            pass  # backpressure: ticks will recover state
+
+    # --- FSM routine ----------------------------------------------------------
+
+    def start_sync(self) -> None:
+        self._running = True
+        self._post(Ev("start"))
+        self._thread = threading.Thread(target=self._routine,
+                                        name="fastsync-v1", daemon=True)
+        self._thread.start()
+
+    def switch_to_fast_sync(self, state) -> None:
+        """Post-state-sync hand-off (same surface as v0)."""
+        self.state = state
+        self.initial_state = state
+        self.pool.height = state.last_block_height + 1
+        self.fast_sync = True
+        self.start_sync()
+
+    def on_stop(self) -> None:
+        self._running = False
+        self._post(Ev("stop"))
+
+    def wait_until_synced(self, timeout: float) -> bool:
+        return self._synced.wait(timeout)
+
+    def _routine(self) -> None:
+        while self._running and self.fsm.state != S_FINISHED:
+            now = time.monotonic()
+            if self.switch is not None and now - self._last_status_bcast > 10.0:
+                self.switch.broadcast(BLOCKCHAIN_CHANNEL, msg_status_request())
+                self._last_status_bcast = now
+            try:
+                ev = self._events.get(timeout=0.05)
+            except queue.Empty:
+                ev = Ev("tick")
+            if ev.kind == "stop":
+                return
+            try:
+                self.fsm.handle(ev)
+            except Exception as e:  # noqa: BLE001 - FSM must survive bad input
+                if self.logger:
+                    self.logger.error("fastsync v1 event failed", err=e)
+
+    # --- actions used by the FSM ---------------------------------------------
+
+    def make_requests(self) -> None:
+        if self.switch is None:
+            return
+        with self.switch._peers_mtx:
+            peers = dict(self.switch.peers)
+        for h, pid in self.pool.wanted_requests():
+            p = peers.get(pid)
+            if p is not None:
+                p.try_send(BLOCKCHAIN_CHANNEL, msg_block_request(h))
+
+    def try_process_block(self) -> bool:
+        """Verify + apply the next contiguous block; False when not ready
+        (reference: processBlock -> VerifyCommitLight at reactor.go:478)."""
+        first, second = self.pool.peek_two_blocks()
+        if first is None or second is None:
+            return False
+        first_parts = PartSet.from_data(first.marshal())
+        first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header())
+        try:
+            if second.last_commit is None:
+                raise ValueError("second block has no LastCommit")
+            if second.last_commit.block_id != first_id:
+                raise ValueError("second block's LastCommit is for a different block")
+            self.state.validators.verify_commit_light(
+                self.state.chain_id, first_id, first.header.height,
+                second.last_commit)
+        except Exception as e:  # noqa: BLE001
+            bad = self.pool.redo_request(first.header.height)
+            if bad:
+                self.drop_peer(bad, f"invalid block: {e}")
+            return False
+        self.pool.pop_request()
+        self.block_store.save_block(first, first_parts, second.last_commit)
+        self.state, _ = self.block_exec.apply_block(self.state, first_id, first)
+        return True
+
+    def on_finished(self) -> None:
+        self._running = False
+        self._synced.set()
+        if self.consensus_reactor is not None:
+            self.consensus_reactor.switch_to_consensus(self.state)

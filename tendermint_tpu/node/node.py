@@ -165,11 +165,14 @@ class Node:
         self.consensus_reactor = ConsensusReactor(self.consensus, wait_sync=wait_sync)
         self.mempool_reactor = MempoolReactor(self.mempool, broadcast=config.mempool.broadcast)
 
-        from tendermint_tpu.blockchain.reactor import BlockchainReactor
         from tendermint_tpu.evidence.reactor import EvidenceReactor
         from tendermint_tpu.statesync import StateSyncReactor, Syncer
 
-        self.bc_reactor = BlockchainReactor(
+        if config.fastsync.version == "v1":
+            from tendermint_tpu.blockchain.v1 import BlockchainReactorV1 as _BCR
+        else:
+            from tendermint_tpu.blockchain.reactor import BlockchainReactor as _BCR
+        self.bc_reactor = _BCR(
             state, self.block_exec, self.block_store, fast_sync,
             self.consensus_reactor)
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)

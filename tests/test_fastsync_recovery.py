@@ -136,3 +136,49 @@ def test_crash_recovery_matrix(tmp_path, fail_index):
     assert doc["state_height"] == doc["height"]
     assert doc["app_height"] == doc["height"]
     assert doc["app_hash"] == doc["state_app_hash"]
+
+
+def test_fastsync_v1_cold_node_catches_up(tmp_path):
+    """The event-driven v1 FSM syncs a cold node over real sockets and hands
+    off to consensus (reference: blockchain/v1/reactor_fsm.go)."""
+    privs = [ed25519.gen_priv_key(bytes([55 + i]) * 32) for i in range(2)]
+    genesis = GenesisDoc(
+        chain_id="fsv1-chain", genesis_time=Time(1700002000, 0),
+        validators=[GenesisValidator(b"", p.pub_key(), 10) for p in privs],
+    )
+    n0 = _mk_node(tmp_path, "w0", genesis, privs[0])
+    n1 = _mk_node(tmp_path, "w1", genesis, privs[1])
+    n0.start()
+    n1.start()
+    late = None
+    try:
+        assert n1.switch.dial_peer(n0.p2p_addr()) is not None
+        assert _wait(lambda: n0.block_store.height >= 22, 90), n0.block_store.height
+
+        cfg = test_config()
+        cfg.set_root(str(tmp_path / "late-v1"))
+        os.makedirs(cfg.base.root_dir, exist_ok=True)
+        cfg.base.fast_sync_mode = True
+        cfg.fastsync.version = "v1"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.pex = False
+        cfg.p2p.persistent_peers = ",".join([n0.p2p_addr(), n1.p2p_addr()])
+        cfg.rpc.laddr = ""
+        cfg.consensus.wal_path = ""
+        late = Node(cfg, genesis=genesis, priv_validator=None,
+                    node_key=NodeKey(ed25519.gen_priv_key(b"\x59" * 32)))
+        from tendermint_tpu.blockchain.v1 import BlockchainReactorV1
+        assert isinstance(late.bc_reactor, BlockchainReactorV1)
+        late.start()
+        assert _wait(lambda: late.block_store.height >= 20, 90), late.block_store.height
+        assert late.block_store.load_block(10).hash() == \
+            n0.block_store.load_block(10).hash()
+        # FSM finished and handed off to consensus; keeps up live
+        assert _wait(late.bc_reactor._synced.is_set, 60)
+        tip = n0.block_store.height
+        assert _wait(lambda: late.block_store.height >= tip + 2, 60)
+    finally:
+        if late is not None:
+            late.stop()
+        n0.stop()
+        n1.stop()

@@ -1,0 +1,99 @@
+"""Direct unit tests for the numeric host-prep code: reduce_mod_l against
+exact integer arithmetic at boundary values, lt_l at the L fence,
+comb_windows bit-exact reconstruction, and the C hash library differential
+against hashlib at padding boundaries (VERDICT round-2 weak #6)."""
+
+import hashlib
+
+import numpy as np
+
+from tendermint_tpu.crypto.ed25519 import L
+from tendermint_tpu.ops import chash
+from tendermint_tpu.ops import scalar25519 as sc
+
+
+def _le(v: int, nbytes: int) -> bytes:
+    return v.to_bytes(nbytes, "little")
+
+
+def test_reduce_mod_l_boundaries_and_random():
+    cases = [
+        0, 1, 2, L - 1, L, L + 1, 2 * L, 2 * L - 1,
+        2**252, 2**252 - 1, 2**255 - 19, 2**256 - 1,
+        2**511, 2**512 - 1,
+        # largest multiple of L that fits in 512 bits, and its neighbors
+        ((2**512 - 1) // L) * L, ((2**512 - 1) // L) * L - 1,
+        # values whose high part stresses every fold stage
+        (L - 1) << 252, ((L - 1) << 252) + L - 1,
+    ]
+    rng = np.random.default_rng(11)
+    cases += [int.from_bytes(rng.bytes(64), "little") for _ in range(500)]
+
+    vals = np.frombuffer(
+        b"".join(_le(v, 64) for v in cases), dtype=np.uint8
+    ).reshape(len(cases), 64)
+    got = sc.reduce_mod_l(np.ascontiguousarray(vals))
+    for i, v in enumerate(cases):
+        want = v % L
+        assert int.from_bytes(bytes(got[i]), "little") == want, hex(v)
+
+
+def test_lt_l_fence():
+    cases = {
+        0: True, 1: True, L - 1: True, L: False, L + 1: False,
+        2**252: True,  # 2^252 < L
+        2**253: False, 2**256 - 1: False,
+    }
+    arr = np.frombuffer(
+        b"".join(_le(v, 32) for v in cases), dtype=np.uint8
+    ).reshape(len(cases), 32)
+    got = sc.lt_l(np.ascontiguousarray(arr))
+    for (v, want), g in zip(cases.items(), got):
+        assert bool(g) == want, hex(v)
+
+
+def test_comb_windows_reconstruct():
+    rng = np.random.default_rng(7)
+    scalars = [0, 1, L - 1, 2**256 - 1] + [
+        int.from_bytes(rng.bytes(32), "little") for _ in range(100)
+    ]
+    arr = np.frombuffer(
+        b"".join(_le(v, 32) for v in scalars), dtype=np.uint8
+    ).reshape(len(scalars), 32)
+    win = sc.comb_windows(np.ascontiguousarray(arr))
+    assert win.shape == (len(scalars), 64) and win.max() <= 15
+    for i, v in enumerate(scalars):
+        # processing order: output column 0 is bit-column 63
+        rec = 0
+        for out_col in range(64):
+            j = 63 - out_col
+            w = int(win[i, out_col])
+            for t in range(4):
+                if w >> t & 1:
+                    rec |= 1 << (j + 64 * t)
+        assert rec == v, hex(v)
+
+
+def test_chash_differential_vs_hashlib():
+    # message lengths straddling SHA-512 (128B block, 112B pad fence) and
+    # SHA-256 (64B block, 56B pad fence) boundaries
+    lengths = [0, 1, 55, 56, 57, 63, 64, 65, 111, 112, 113, 127, 128, 129,
+               255, 256, 1000]
+    msgs = [bytes([i % 256]) * n for i, n in enumerate(lengths)]
+
+    got512 = chash.sha512_many(msgs)
+    for i, m in enumerate(msgs):
+        assert bytes(got512[i]) == hashlib.sha512(m).digest(), len(m)
+
+    got256 = chash.sha256_many(msgs)
+    for i, m in enumerate(msgs):
+        assert bytes(got256[i]) == hashlib.sha256(m).digest(), len(m)
+
+    n = len(msgs)
+    r32 = np.frombuffer(bytes(range(32)) * n, dtype=np.uint8).reshape(n, 32)
+    a32 = np.frombuffer(bytes(range(32, 64)) * n, dtype=np.uint8).reshape(n, 32)
+    got = chash.sha512_rab(np.ascontiguousarray(r32),
+                           np.ascontiguousarray(a32), msgs)
+    for i, m in enumerate(msgs):
+        want = hashlib.sha512(bytes(r32[i]) + bytes(a32[i]) + m).digest()
+        assert bytes(got[i]) == want, len(m)
