@@ -418,6 +418,20 @@ def cmd_debug(args) -> int:
     return 0
 
 
+def cmd_probe_upnp(args) -> int:
+    """Probe for a UPnP gateway (reference: cmd/tendermint/commands/
+    probe_upnp.go)."""
+    from tendermint_tpu.p2p import upnp
+
+    try:
+        out = upnp.probe(timeout_s=args.timeout)
+    except upnp.UPnPError as e:
+        print(f"Probe failed: {e}")
+        return 1
+    print(json.dumps(out, indent=2))
+    return 0
+
+
 def cmd_abci_server(args) -> int:
     """Run the kvstore app behind an ABCI socket (reference:
     abci/cmd/abci-cli: kvstore subcommand)."""
@@ -501,6 +515,10 @@ def main(argv=None) -> int:
     sp.add_argument("--output", default="")
     sp.add_argument("--rpc-laddr", default="", help="running node RPC to query")
     sp.set_defaults(fn=cmd_debug)
+
+    sp = sub.add_parser("probe-upnp", help="probe for a UPnP gateway")
+    sp.add_argument("--timeout", type=float, default=3.0)
+    sp.set_defaults(fn=cmd_probe_upnp)
 
     sp = sub.add_parser("abci-server", help="run the kvstore app behind a socket")
     sp.add_argument("--address", default="tcp://127.0.0.1:26658")

@@ -1,0 +1,163 @@
+"""UPnP IGD port mapping (reference: p2p/upnp/upnp.go, probe.go).
+
+Discovers an Internet Gateway Device via SSDP multicast, fetches its root
+description to find the WANIPConnection control URL, then drives the SOAP
+actions the reference uses: GetExternalIPAddress, AddPortMapping,
+DeletePortMapping.
+
+Pure stdlib (socket + urllib + minimal XML scraping); the discovery probe
+is what `tendermint probe-upnp` runs (reference: probe.go:15 Probe).
+"""
+
+from __future__ import annotations
+
+import re
+import socket
+import urllib.request
+from dataclasses import dataclass
+
+SSDP_ADDR = "239.255.255.250"
+SSDP_PORT = 1900
+SEARCH_TARGET = "urn:schemas-upnp-org:device:InternetGatewayDevice:1"
+_SERVICE_TYPES = (
+    "urn:schemas-upnp-org:service:WANIPConnection:1",
+    "urn:schemas-upnp-org:service:WANPPPConnection:1",
+)
+
+
+class UPnPError(Exception):
+    pass
+
+
+@dataclass
+class IGD:
+    """A discovered gateway (reference: upnp.go upnpNAT)."""
+
+    location: str
+    control_url: str
+    service_type: str
+
+
+def discover(timeout_s: float = 3.0, ssdp_addr: str = SSDP_ADDR,
+             ssdp_port: int = SSDP_PORT) -> IGD:
+    """SSDP M-SEARCH for an IGD (reference: upnp.go:77 Discover)."""
+    msg = (
+        "M-SEARCH * HTTP/1.1\r\n"
+        f"HOST: {ssdp_addr}:{ssdp_port}\r\n"
+        'MAN: "ssdp:discover"\r\n'
+        f"ST: {SEARCH_TARGET}\r\n"
+        "MX: 2\r\n\r\n"
+    ).encode()
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(timeout_s)
+    try:
+        s.sendto(msg, (ssdp_addr, ssdp_port))
+        while True:
+            try:
+                data, _ = s.recvfrom(4096)
+            except socket.timeout:
+                raise UPnPError("no UPnP gateway responded") from None
+            m = re.search(rb"(?im)^location:\s*(\S+)", data)
+            if m:
+                return _probe_location(m.group(1).decode())
+    finally:
+        s.close()
+
+
+def _probe_location(location: str) -> IGD:
+    """Fetch the root description and locate the WAN connection control URL
+    (reference: upnp.go getServiceURL)."""
+    with urllib.request.urlopen(location, timeout=5) as r:
+        desc = r.read().decode(errors="replace")
+    for st in _SERVICE_TYPES:
+        # serviceType block followed by its controlURL
+        pat = re.compile(
+            r"<serviceType>\s*" + re.escape(st)
+            + r"\s*</serviceType>.*?<controlURL>\s*([^<]+?)\s*</controlURL>",
+            re.S)
+        m = pat.search(desc)
+        if m:
+            control = m.group(1)
+            if not control.startswith("http"):
+                base = location.split("/", 3)
+                control = f"{base[0]}//{base[2]}{control if control.startswith('/') else '/' + control}"
+            return IGD(location=location, control_url=control, service_type=st)
+    raise UPnPError("gateway exposes no WAN*Connection service")
+
+
+def _soap(igd: IGD, action: str, args_xml: str) -> str:
+    body = (
+        '<?xml version="1.0"?>'
+        '<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/" '
+        's:encodingStyle="http://schemas.xmlsoap.org/soap/encoding/">'
+        f'<s:Body><u:{action} xmlns:u="{igd.service_type}">{args_xml}'
+        f"</u:{action}></s:Body></s:Envelope>"
+    ).encode()
+    req = urllib.request.Request(
+        igd.control_url, data=body,
+        headers={
+            "Content-Type": 'text/xml; charset="utf-8"',
+            "SOAPAction": f'"{igd.service_type}#{action}"',
+        })
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return r.read().decode(errors="replace")
+
+
+def get_external_ip(igd: IGD) -> str:
+    """reference: upnp.go GetExternalIPAddress."""
+    resp = _soap(igd, "GetExternalIPAddress", "")
+    m = re.search(r"<NewExternalIPAddress>\s*([^<]+?)\s*</NewExternalIPAddress>",
+                  resp)
+    if not m:
+        raise UPnPError("no external IP in gateway response")
+    return m.group(1)
+
+
+def _local_ip_for(igd: IGD) -> str:
+    host = igd.control_url.split("/")[2].rsplit(":", 1)[0]
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((host, 1))
+        return s.getsockname()[0]
+    finally:
+        s.close()
+
+
+def add_port_mapping(igd: IGD, external_port: int, internal_port: int,
+                     protocol: str = "TCP", description: str = "tendermint-tpu",
+                     lease_s: int = 0, internal_ip: str = "") -> None:
+    """reference: upnp.go AddPortMapping."""
+    ip = internal_ip or _local_ip_for(igd)
+    _soap(igd, "AddPortMapping", (
+        "<NewRemoteHost></NewRemoteHost>"
+        f"<NewExternalPort>{external_port}</NewExternalPort>"
+        f"<NewProtocol>{protocol}</NewProtocol>"
+        f"<NewInternalPort>{internal_port}</NewInternalPort>"
+        f"<NewInternalClient>{ip}</NewInternalClient>"
+        "<NewEnabled>1</NewEnabled>"
+        f"<NewPortMappingDescription>{description}</NewPortMappingDescription>"
+        f"<NewLeaseDuration>{lease_s}</NewLeaseDuration>"
+    ))
+
+
+def delete_port_mapping(igd: IGD, external_port: int,
+                        protocol: str = "TCP") -> None:
+    """reference: upnp.go DeletePortMapping."""
+    _soap(igd, "DeletePortMapping", (
+        "<NewRemoteHost></NewRemoteHost>"
+        f"<NewExternalPort>{external_port}</NewExternalPort>"
+        f"<NewProtocol>{protocol}</NewProtocol>"
+    ))
+
+
+def probe(timeout_s: float = 3.0, **discover_kwargs) -> dict:
+    """Capability probe (reference: probe.go:15): discover, fetch the
+    external IP, round-trip a test mapping."""
+    igd = discover(timeout_s, **discover_kwargs)
+    out = {"location": igd.location, "control_url": igd.control_url,
+           "service_type": igd.service_type}
+    out["external_ip"] = get_external_ip(igd)
+    add_port_mapping(igd, 26656, 26656, description="tendermint-tpu probe")
+    delete_port_mapping(igd, 26656)
+    out["port_mapping"] = "ok"
+    return out

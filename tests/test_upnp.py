@@ -1,0 +1,121 @@
+"""UPnP against a fake in-process IGD: SSDP discovery, description parsing,
+GetExternalIPAddress, Add/DeletePortMapping SOAP round-trips (reference:
+p2p/upnp/upnp.go)."""
+
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tendermint_tpu.p2p import upnp
+
+DESC_XML = """<?xml version="1.0"?>
+<root xmlns="urn:schemas-upnp-org:device-1-0">
+ <device>
+  <deviceType>urn:schemas-upnp-org:device:InternetGatewayDevice:1</deviceType>
+  <serviceList>
+   <service>
+    <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+    <controlURL>/ctl</controlURL>
+   </service>
+  </serviceList>
+ </device>
+</root>"""
+
+
+class _FakeIGD:
+    def __init__(self):
+        self.actions = []
+
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = DESC_XML.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n).decode()
+                action = self.headers.get("SOAPAction", "").split("#")[-1].strip('"')
+                fake.actions.append((action, body))
+                if action == "GetExternalIPAddress":
+                    resp = ("<r><NewExternalIPAddress>203.0.113.7"
+                            "</NewExternalIPAddress></r>")
+                else:
+                    resp = "<r/>"
+                out = resp.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.http_port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+        # SSDP responder on loopback UDP
+        self.udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.udp.bind(("127.0.0.1", 0))
+        self.udp_port = self.udp.getsockname()[1]
+
+        def ssdp():
+            while True:
+                try:
+                    data, addr = self.udp.recvfrom(4096)
+                except OSError:
+                    return
+                if b"M-SEARCH" in data:
+                    resp = (f"HTTP/1.1 200 OK\r\n"
+                            f"LOCATION: http://127.0.0.1:{self.http_port}/desc.xml\r\n"
+                            f"ST: {upnp.SEARCH_TARGET}\r\n\r\n").encode()
+                    self.udp.sendto(resp, addr)
+
+        threading.Thread(target=ssdp, daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.udp.close()
+
+
+def test_upnp_against_fake_igd():
+    fake = _FakeIGD()
+    try:
+        igd = upnp.discover(timeout_s=3.0, ssdp_addr="127.0.0.1",
+                            ssdp_port=fake.udp_port)
+        assert igd.control_url == f"http://127.0.0.1:{fake.http_port}/ctl"
+        assert igd.service_type.endswith("WANIPConnection:1")
+
+        assert upnp.get_external_ip(igd) == "203.0.113.7"
+
+        upnp.add_port_mapping(igd, 26656, 26656, description="test-map")
+        upnp.delete_port_mapping(igd, 26656)
+        names = [a for a, _ in fake.actions]
+        assert names == ["GetExternalIPAddress", "AddPortMapping",
+                         "DeletePortMapping"]
+        add_body = fake.actions[1][1]
+        assert "<NewExternalPort>26656</NewExternalPort>" in add_body
+        assert "<NewProtocol>TCP</NewProtocol>" in add_body
+        assert "test-map" in add_body
+    finally:
+        fake.close()
+
+
+def test_upnp_discover_timeout():
+    with pytest.raises(upnp.UPnPError):
+        # a bound-but-silent port: nothing answers the M-SEARCH
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("127.0.0.1", 0))
+        try:
+            upnp.discover(timeout_s=0.3, ssdp_addr="127.0.0.1",
+                          ssdp_port=s.getsockname()[1])
+        finally:
+            s.close()
