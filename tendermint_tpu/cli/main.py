@@ -269,6 +269,13 @@ def cmd_light(args) -> int:
                     max_clock_drift_s=120.0)
     print(f"Light client running against {args.primary} "
           f"(latest trusted: {client.latest_trusted.height})")
+    proxy = None
+    if args.laddr:
+        from tendermint_tpu.light.proxy import LightProxy
+
+        proxy = LightProxy(client, args.primary, args.laddr)
+        proxy.start()
+        print(f"Verifying proxy listening on {proxy.laddr}")
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     while not stop:
@@ -282,6 +289,8 @@ def cmd_light(args) -> int:
         if args.once:
             break
         time.sleep(args.interval)
+    if proxy is not None:
+        proxy.stop()
     return 0
 
 
@@ -498,6 +507,8 @@ def main(argv=None) -> int:
                     default=168 * 3600.0)
     sp.add_argument("--interval", type=float, default=1.0)
     sp.add_argument("--once", action="store_true", help="single update then exit")
+    sp.add_argument("--laddr", default="",
+                    help="serve a verifying RPC proxy on this address")
     sp.set_defaults(fn=cmd_light)
 
     sp = sub.add_parser("replay", help="replay the block store through the app")

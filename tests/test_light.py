@@ -301,3 +301,90 @@ def test_range_verify_broken_linkage(keys):
     c = gen_chain(5, privs, vs)
     with pytest.raises(RangeVerifyError):
         verify_header_range(c[0], [c[1], c[3]], TRUST_PERIOD, t(900), DRIFT)
+
+
+def test_light_proxy_serves_verified_data(tmp_path):
+    """LightProxy: commit/validators/light_block come from verified light
+    blocks; raw blocks are accepted only when they hash to the verified
+    header (reference: light/proxy/proxy.go)."""
+    import json
+    import os
+    import time as _time
+    import urllib.request
+
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.light.proxy import LightProxy
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.privval.file_pv import MockPV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types.light_block import LightBlock
+
+    priv = ed25519.gen_priv_key(b"\x53" * 32)
+    genesis = GenesisDoc(chain_id="lp-chain", genesis_time=Time(1700003000, 0),
+                         validators=[GenesisValidator(b"", priv.pub_key(), 10)])
+    cfg = test_config()
+    cfg.set_root(str(tmp_path / "node"))
+    os.makedirs(cfg.base.root_dir, exist_ok=True)
+    cfg.base.fast_sync_mode = False
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.consensus.wal_path = ""
+    node = Node(cfg, genesis=genesis, priv_validator=MockPV(priv),
+                node_key=NodeKey(ed25519.gen_priv_key(b"\x54" * 32)))
+    node.start()
+    proxy = None
+    try:
+        deadline = _time.monotonic() + 60
+        while _time.monotonic() < deadline and node.block_store.height < 4:
+            _time.sleep(0.1)
+        base = "http://" + node.rpc_server.laddr.split("://", 1)[1]
+        from tendermint_tpu.light import Client, DBStore, HTTPProvider, TrustOptions
+        from tendermint_tpu.store.db import MemDB
+
+        primary = HTTPProvider("lp-chain", base)
+        anchor = primary.light_block(1)
+        client = Client("lp-chain",
+                        TrustOptions(period_s=10 * 365 * 24 * 3600.0, height=1,
+                                     hash=anchor.hash()),
+                        primary, [], DBStore(MemDB()), max_clock_drift_s=120.0)
+        proxy = LightProxy(client, base)
+        proxy.start()
+
+        def rpc(method, params=None):
+            body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                               "params": params or {}}).encode()
+            addr = "http://" + proxy.laddr.split("://", 1)[1]
+            with urllib.request.urlopen(urllib.request.Request(
+                    addr, data=body,
+                    headers={"Content-Type": "application/json"}), timeout=10) as r:
+                doc = json.loads(r.read())
+            if doc.get("error"):
+                raise RuntimeError(doc["error"])
+            return doc["result"]
+
+        assert rpc("health") == {}
+        st = rpc("status")
+        assert st["node_info"]["network"] == "lp-chain"
+
+        c = rpc("commit", {"height": 3})
+        assert c["verified"] and c["signed_header"]["height"] == "3"
+
+        v = rpc("validators", {"height": 3})
+        assert v["verified"] and v["total"] == "1"
+
+        lb_doc = rpc("light_block", {"height": 3})
+        lb = LightBlock.unmarshal(bytes.fromhex(lb_doc["light_block"]))
+        lb.validate_basic("lp-chain")
+
+        b = rpc("block", {"height": 3})
+        assert b["verified"]
+        assert b["block"]["header"]["height"] == "3"
+
+        # the proxy's trusted store grew through these verifications
+        assert client.trusted_store.light_block(3) is not None
+    finally:
+        if proxy is not None:
+            proxy.stop()
+        node.stop()
