@@ -82,6 +82,11 @@ class Client:
         pruning_size: int = DEFAULT_PRUNING_SIZE,
         logger=None,
     ):
+        import threading
+
+        # one lock around all public verification entry points (the Go
+        # reference holds c.mtx); providers/stores are not thread-safe
+        self._mtx = threading.RLock()
         if verification_mode not in (SEQUENTIAL, SKIPPING):
             raise LightClientError(f"unknown verification mode {verification_mode}")
         validate_trust_level(trust_level)
@@ -162,29 +167,35 @@ class Client:
     def update(self, now: Time) -> LightBlock | None:
         """Verify the latest header from primary if newer than latest trusted
         (reference: light/client.go:443 Update)."""
-        latest_trusted = self.latest_trusted
-        if latest_trusted is None:
-            raise LightClientError("no trusted state yet")
-        latest = self._light_block_from_primary(0)
-        if latest.height > latest_trusted.height:
-            self.verify_light_block(latest, now)
-            return latest
-        return None
+        with self._mtx:
+            latest_trusted = self.latest_trusted
+            if latest_trusted is None:
+                raise LightClientError("no trusted state yet")
+            latest = self._light_block_from_primary(0)
+            if latest.height > latest_trusted.height:
+                self.verify_light_block(latest, now)
+                return latest
+            return None
 
     def verify_light_block_at_height(self, height: int, now: Time) -> LightBlock:
         """reference: light/client.go:474 VerifyLightBlockAtHeight."""
-        if height <= 0:
-            raise LightClientError("negative or zero height")
-        lb = self.trusted_store.light_block(height)
-        if lb is not None:
+        with self._mtx:
+            if height <= 0:
+                raise LightClientError("negative or zero height")
+            lb = self.trusted_store.light_block(height)
+            if lb is not None:
+                return lb
+            lb = self._light_block_from_primary(height)
+            self.verify_light_block(lb, now)
             return lb
-        lb = self._light_block_from_primary(height)
-        self.verify_light_block(lb, now)
-        return lb
 
     def verify_light_block(self, new_lb: LightBlock, now: Time) -> None:
         """reference: light/client.go:525 VerifyHeader (+ :558
         verifyLightBlock)."""
+        with self._mtx:
+            self._verify_light_block_locked(new_lb, now)
+
+    def _verify_light_block_locked(self, new_lb: LightBlock, now: Time) -> None:
         h = self.trusted_store.light_block(new_lb.height)
         if h is not None:
             if h.hash() == new_lb.hash():

@@ -132,6 +132,36 @@ class NodeProvider(Provider):
         self.evidences.append(ev)
 
 
+def json_rpc_call(base_url: str, method: str, params: dict,
+                  timeout: float = 5.0, rid: int = 1):
+    """One JSON-RPC 2.0 POST round trip; raises a ProviderError subclass.
+
+    Error-message taxonomy is part of the wire contract with rpc/core.py's
+    light_block route: a lagging node says "must be less" (ErrHeightTooHigh,
+    tolerated by the detector as "hasn't caught up"), a pruned/missing block
+    says "could not find" (ErrLightBlockNotFound, witness treated as dead).
+    Shared by HTTPProvider and light/proxy."""
+    body = json.dumps(
+        {"jsonrpc": "2.0", "id": rid, "method": method, "params": params}
+    ).encode()
+    req = urllib.request.Request(
+        base_url, data=body, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            payload = json.loads(resp.read())
+    except OSError as e:
+        raise ErrNoResponse(str(e)) from e
+    if payload.get("error"):
+        msg = str(payload["error"])
+        if "must be less" in msg:
+            raise ErrHeightTooHigh(msg)
+        if "not find" in msg or "not found" in msg:
+            raise ErrLightBlockNotFound(msg)
+        raise ProviderError(msg)
+    return payload["result"]
+
+
 class HTTPProvider(Provider):
     """JSON-RPC provider (reference: light/provider/http/http.go:65).
 
@@ -151,29 +181,7 @@ class HTTPProvider(Provider):
 
     def _call(self, method: str, params: dict):
         self._rid += 1
-        body = json.dumps(
-            {"jsonrpc": "2.0", "id": self._rid, "method": method, "params": params}
-        ).encode()
-        req = urllib.request.Request(
-            self._base, data=body, headers={"Content-Type": "application/json"}
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=self._timeout) as resp:
-                payload = json.loads(resp.read())
-        except OSError as e:
-            raise ErrNoResponse(str(e)) from e
-        if payload.get("error"):
-            msg = str(payload["error"])
-            # Wire contract with rpc/core.py light_block: a lagging node says
-            # "must be less" (ErrHeightTooHigh, tolerated by the detector as
-            # "hasn't caught up"), a pruned/missing block says "could not
-            # find" (ErrLightBlockNotFound, witness treated as dead).
-            if "must be less" in msg:
-                raise ErrHeightTooHigh(msg)
-            if "not find" in msg or "not found" in msg:
-                raise ErrLightBlockNotFound(msg)
-            raise ProviderError(msg)
-        return payload["result"]
+        return json_rpc_call(self._base, method, params, self._timeout, self._rid)
 
     def light_block(self, height: int) -> LightBlock:
         params = {} if height == 0 else {"height": str(height)}

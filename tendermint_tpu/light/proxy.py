@@ -10,11 +10,13 @@ light-client security.
 
 from __future__ import annotations
 
+import base64
 import json
 import threading
-import urllib.request
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from tendermint_tpu.light.provider import json_rpc_call
 from tendermint_tpu.types.ttime import Time
 
 
@@ -28,6 +30,14 @@ class LightProxy:
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _respond(self, doc):
+                body = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
                 try:
@@ -38,12 +48,22 @@ class LightProxy:
                 except Exception as e:  # noqa: BLE001
                     doc = {"jsonrpc": "2.0", "id": None,
                            "error": {"code": -32603, "message": str(e)}}
-                body = json.dumps(doc).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._respond(doc)
+
+            def do_GET(self):
+                # URI form like the node RPC: GET /status, /block?height=3
+                # (rpc/server.py serves the same shape)
+                try:
+                    parsed = urllib.parse.urlparse(self.path)
+                    method = parsed.path.strip("/")
+                    params = {k: v[-1] for k, v in
+                              urllib.parse.parse_qs(parsed.query).items()}
+                    result = proxy._dispatch(method, params)
+                    doc = {"jsonrpc": "2.0", "id": -1, "result": result}
+                except Exception as e:  # noqa: BLE001
+                    doc = {"jsonrpc": "2.0", "id": -1,
+                           "error": {"code": -32603, "message": str(e)}}
+                self._respond(doc)
 
             def log_message(self, *a):
                 pass
@@ -99,17 +119,15 @@ class LightProxy:
                 "verified": True,
             }
         if method == "block":
-            # Raw block from the primary, accepted only if it hashes to the
-            # VERIFIED header (reference: proxy makes the same check through
-            # rpc verification wrappers).
+            # Raw block from the primary, accepted only when its CONTENT
+            # matches the verified header: every hash anchor in the returned
+            # header JSON must equal the verified header's, and the tx list
+            # must merkle-hash to the verified data_hash. The primary's own
+            # block_id claim is never trusted (reference: the proxy's rpc
+            # verification wrappers make the same binding).
             lb = self._verified(params)
-            upstream = self._forward("block", params)
-            got = upstream.get("block_id", {}).get("hash", "")
-            want = lb.hash().hex().upper()
-            if got.upper() != want:
-                raise ValueError(
-                    f"primary returned a block whose hash {got} does not "
-                    f"match the verified header {want}")
+            upstream = self._forward("block", {"height": str(lb.height)})
+            self._check_block_against_header(upstream, lb)
             upstream["verified"] = True
             return upstream
         # everything else passes through unverified-but-labeled
@@ -127,14 +145,43 @@ class LightProxy:
             return lb
         return self.client.verify_light_block_at_height(height, Time.now())
 
+    def _check_block_against_header(self, upstream: dict, lb) -> None:
+        """Bind the primary's JSON block to the VERIFIED header: compare all
+        hash anchors field by field and recompute the tx merkle root."""
+        vh = lb.signed_header.header
+        jh = upstream.get("block", {}).get("header", {})
+
+        def hx(b: bytes) -> str:
+            return (b or b"").hex().upper()
+
+        anchors = {
+            "height": str(vh.height),
+            "chain_id": vh.chain_id,
+            "app_hash": hx(vh.app_hash),
+            "data_hash": hx(vh.data_hash),
+            "validators_hash": hx(vh.validators_hash),
+            "next_validators_hash": hx(vh.next_validators_hash),
+            "consensus_hash": hx(vh.consensus_hash),
+            "last_results_hash": hx(vh.last_results_hash),
+            "evidence_hash": hx(vh.evidence_hash),
+            "last_commit_hash": hx(vh.last_commit_hash),
+            "proposer_address": hx(vh.proposer_address),
+        }
+        for key, want in anchors.items():
+            got = str(jh.get(key, ""))
+            if got != want:
+                raise ValueError(
+                    f"primary block header field {key!r} = {got!r} does not "
+                    f"match verified header {want!r}")
+        from tendermint_tpu.types.tx import txs_hash
+
+        txs = [base64.b64decode(t)
+               for t in upstream.get("block", {}).get("data", {}).get("txs", [])]
+        data_hash = txs_hash(txs)
+        if hx(data_hash) != anchors["data_hash"]:
+            raise ValueError(
+                "primary block txs do not merkle-hash to the verified "
+                f"data_hash ({hx(data_hash)} != {anchors['data_hash']})")
+
     def _forward(self, method: str, params: dict):
-        body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
-                           "params": params}).encode()
-        req = urllib.request.Request(
-            self.primary_rpc, data=body,
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=10) as r:
-            doc = json.loads(r.read())
-        if doc.get("error"):
-            raise ValueError(str(doc["error"]))
-        return doc["result"]
+        return json_rpc_call(self.primary_rpc, method, params, timeout=10)

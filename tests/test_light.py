@@ -382,6 +382,31 @@ def test_light_proxy_serves_verified_data(tmp_path):
         assert b["verified"]
         assert b["block"]["header"]["height"] == "3"
 
+        # URI-style GET works like the node RPC
+        addr = "http://" + proxy.laddr.split("://", 1)[1]
+        with urllib.request.urlopen(f"{addr}/status", timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["result"]["node_info"]["network"] == "lp-chain"
+
+        # a primary lying about block content is caught: tamper with the
+        # forwarded block and run the binding check directly
+        lb3 = client.trusted_store.light_block(3)
+        tampered = json.loads(json.dumps(b))
+        tampered["block"]["data"]["txs"] = [
+            __import__("base64").b64encode(b"forged=tx").decode()]
+        try:
+            proxy._check_block_against_header(tampered, lb3)
+            raise AssertionError("tampered txs accepted")
+        except ValueError as e:
+            assert "merkle" in str(e)
+        tampered2 = json.loads(json.dumps(b))
+        tampered2["block"]["header"]["app_hash"] = "AB" * 32
+        try:
+            proxy._check_block_against_header(tampered2, lb3)
+            raise AssertionError("tampered app_hash accepted")
+        except ValueError as e:
+            assert "app_hash" in str(e)
+
         # the proxy's trusted store grew through these verifications
         assert client.trusted_store.light_block(3) is not None
     finally:
