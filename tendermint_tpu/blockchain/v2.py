@@ -1,0 +1,379 @@
+"""Fast sync v2: routine-based scheduler/processor (reference:
+blockchain/v2/scheduler.go, processor.go, routine.go, reactor.go).
+
+Same wire protocol + verification as v0/v1; the v2 architecture splits the
+work into two independent routines connected by event queues:
+
+  scheduler  -- owns peer state + block request planning (which height from
+                which peer, in-flight tracking, timeouts, peer scoring)
+  processor  -- owns verification + application of contiguous blocks
+                (VerifyCommitLight per block, the batched kernel call)
+
+The demuxer (the reactor) routes wire messages to the scheduler, scheduler
+decisions to the network, fetched blocks to the processor, and processor
+verdicts back to the scheduler. Selected with config.fastsync.version="v2".
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tendermint_tpu.blockchain.reactor import (
+    BLOCKCHAIN_CHANNEL,
+    msg_block_request,
+    msg_block_response,
+    msg_no_block_response,
+    msg_status_request,
+    msg_status_response,
+)
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Peer, Reactor
+from tendermint_tpu.types.block import Block
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.part_set import PartSet
+
+REQUEST_TIMEOUT_S = 10.0
+MAX_IN_FLIGHT_PER_PEER = 8
+
+
+# --- events (reference: blockchain/v2/events.go + scheduler.go) -------------
+
+
+@dataclass
+class EvAddPeer:
+    peer_id: str
+
+
+@dataclass
+class EvRemovePeer:
+    peer_id: str
+
+
+@dataclass
+class EvStatus:
+    peer_id: str
+    base: int
+    height: int
+
+
+@dataclass
+class EvBlockResponse:
+    peer_id: str
+    block: Block
+
+
+@dataclass
+class EvNoBlock:
+    peer_id: str
+    height: int
+
+
+@dataclass
+class EvBlockProcessed:
+    height: int
+
+
+@dataclass
+class EvBlockInvalid:
+    height: int
+    peer_id: str
+
+
+@dataclass
+class EvTick:
+    pass
+
+
+class Scheduler:
+    """Pure planning state machine (reference: scheduler.go:136 scheduler).
+
+    handle(event) -> list of actions: ("request", peer_id, height) |
+    ("drop_peer", peer_id, reason) | ("finished",)."""
+
+    def __init__(self, initial_height: int):
+        self.height = initial_height  # next height to schedule/process
+        self.peers: dict[str, tuple[int, int]] = {}  # id -> (base, top)
+        self.pending: dict[int, tuple[str, float]] = {}  # height -> (peer, at)
+        self.received: set[int] = set()
+
+    def max_peer_height(self) -> int:
+        return max((t for _, t in self.peers.values()), default=0)
+
+    def handle(self, ev) -> list[tuple]:
+        acts: list[tuple] = []
+        if isinstance(ev, EvStatus):
+            self.peers[ev.peer_id] = (ev.base, ev.height)
+        elif isinstance(ev, (EvAddPeer,)):
+            pass  # peer becomes schedulable once its status arrives
+        elif isinstance(ev, EvRemovePeer):
+            self.peers.pop(ev.peer_id, None)
+            for h in [h for h, (p, _) in self.pending.items() if p == ev.peer_id]:
+                del self.pending[h]
+        elif isinstance(ev, EvBlockResponse):
+            h = ev.block.header.height
+            self.pending.pop(h, None)
+            self.received.add(h)
+        elif isinstance(ev, EvNoBlock):
+            self.pending.pop(ev.height, None)
+            acts.append(("drop_peer", ev.peer_id, "no block for advertised height"))
+        elif isinstance(ev, EvBlockProcessed):
+            self.height = ev.height + 1
+            self.received.discard(ev.height)
+            if self.caught_up():
+                acts.append(("finished",))
+                return acts
+        elif isinstance(ev, EvBlockInvalid):
+            # everything from that peer is suspect; re-schedule
+            acts.append(("drop_peer", ev.peer_id, "invalid block"))
+            self.received.discard(ev.height)
+        elif isinstance(ev, EvTick):
+            now = time.monotonic()
+            for h, (p, at) in list(self.pending.items()):
+                if now - at > REQUEST_TIMEOUT_S:
+                    del self.pending[h]  # retry elsewhere
+            if self.caught_up():
+                acts.append(("finished",))
+                return acts
+        acts.extend(self._schedule())
+        return acts
+
+    def caught_up(self) -> bool:
+        """v0 semantics (pool.is_caught_up): next height to sync has reached
+        the best peer's tip -- the tip block itself commits via consensus."""
+        return bool(self.peers) and self.height >= self.max_peer_height()
+
+    def _schedule(self) -> list[tuple]:
+        """Plan new requests (reference: scheduler.go trySchedule)."""
+        acts = []
+        in_flight: dict[str, int] = {}
+        for p, _ in self.pending.values():
+            in_flight[p] = in_flight.get(p, 0) + 1
+        for h in range(self.height, self.height + 32):
+            if h in self.pending or h in self.received:
+                continue
+            candidates = [p for p, (b, t) in self.peers.items()
+                          if b <= h <= t and in_flight.get(p, 0) < MAX_IN_FLIGHT_PER_PEER]
+            if not candidates:
+                continue
+            peer = candidates[h % len(candidates)]
+            in_flight[peer] = in_flight.get(peer, 0) + 1
+            self.pending[h] = (peer, time.monotonic())
+            acts.append(("request", peer, h))
+        return acts
+
+
+class Processor:
+    """Verify + apply contiguous blocks (reference: processor.go:38
+    pcState). Owns the block buffer; emits processed/invalid events."""
+
+    def __init__(self, state, block_exec, block_store):
+        self.state = state
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.blocks: dict[int, tuple[Block, str]] = {}
+
+    def add(self, block: Block, peer_id: str) -> None:
+        self.blocks[block.header.height] = (block, peer_id)
+
+    def purge_peer(self, peer_id: str) -> None:
+        for h in [h for h, (_, p) in self.blocks.items() if p == peer_id]:
+            del self.blocks[h]
+
+    def try_process(self, height: int) -> list:
+        """Process as many contiguous (first, second) pairs as available
+        (reference: processor.go handleProcessBlock)."""
+        events = []
+        while True:
+            first = self.blocks.get(height)
+            second = self.blocks.get(height + 1)
+            if first is None or second is None:
+                return events
+            block, peer_id = first
+            first_parts = PartSet.from_data(block.marshal())
+            first_id = BlockID(hash=block.hash(),
+                               part_set_header=first_parts.header())
+            try:
+                sec = second[0]
+                if sec.last_commit is None:
+                    raise ValueError("second block has no LastCommit")
+                if sec.last_commit.block_id != first_id:
+                    raise ValueError("second block's LastCommit mismatch")
+                self.state.validators.verify_commit_light(
+                    self.state.chain_id, first_id, block.header.height,
+                    sec.last_commit)
+            except Exception:  # noqa: BLE001
+                del self.blocks[height]
+                events.append(EvBlockInvalid(height, peer_id))
+                return events
+            del self.blocks[height]
+            self.block_store.save_block(block, first_parts, sec.last_commit)
+            self.state, _ = self.block_exec.apply_block(self.state, first_id, block)
+            events.append(EvBlockProcessed(height))
+            height += 1
+
+
+class BlockchainReactorV2(Reactor):
+    """The demuxer (reference: blockchain/v2/reactor.go)."""
+
+    def __init__(self, state, block_exec, block_store, fast_sync: bool,
+                 consensus_reactor=None, logger=None):
+        super().__init__("BLOCKCHAIN")
+        self.state = state
+        self.initial_state = state
+        self.fast_sync = fast_sync
+        self.block_store = block_store
+        self.consensus_reactor = consensus_reactor
+        self.logger = logger
+        self.scheduler = Scheduler(block_store.height + 1)
+        self.processor = Processor(state, block_exec, block_store)
+        self._events: queue.Queue = queue.Queue(maxsize=2000)
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._synced = threading.Event()
+        self._started_at = 0.0
+        self._last_status_bcast = 0.0
+
+    # expose pool-compat surface used by tests/tools
+    @property
+    def pool(self):
+        return self.scheduler
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(BLOCKCHAIN_CHANNEL, priority=10,
+                                  recv_message_capacity=50 * 1024 * 1024)]
+
+    def add_peer(self, peer: Peer) -> None:
+        peer.try_send(BLOCKCHAIN_CHANNEL,
+                      msg_status_response(self.block_store.height,
+                                          self.block_store.base))
+        peer.try_send(BLOCKCHAIN_CHANNEL, msg_status_request())
+        self._post(EvAddPeer(peer.id))
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        self._post(EvRemovePeer(peer.id))
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        f = proto.fields(msg_bytes)
+        if 1 in f:  # BlockRequest: serving side
+            m = proto.fields(f[1][-1])
+            height = proto.as_sint64(m.get(1, [0])[-1])
+            block = self.block_store.load_block(height)
+            if block is not None:
+                peer.try_send(BLOCKCHAIN_CHANNEL, msg_block_response(block))
+            else:
+                peer.try_send(BLOCKCHAIN_CHANNEL, msg_no_block_response(height))
+        elif 2 in f:
+            m = proto.fields(f[2][-1])
+            self._post(EvNoBlock(peer.id, proto.as_sint64(m.get(1, [0])[-1])))
+        elif 3 in f:
+            m = proto.fields(f[3][-1])
+            self._post(EvBlockResponse(peer.id,
+                                       Block.unmarshal(m.get(1, [b""])[-1])))
+        elif 4 in f:
+            peer.try_send(BLOCKCHAIN_CHANNEL,
+                          msg_status_response(self.block_store.height,
+                                              self.block_store.base))
+        elif 5 in f:
+            m = proto.fields(f[5][-1])
+            self._post(EvStatus(peer.id,
+                                proto.as_sint64(m.get(2, [0])[-1]),
+                                proto.as_sint64(m.get(1, [0])[-1])))
+
+    def _post(self, ev) -> None:
+        try:
+            self._events.put_nowait(ev)
+        except queue.Full:
+            pass
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def start_sync(self) -> None:
+        self._running = True
+        self._started_at = time.monotonic()
+        self._thread = threading.Thread(target=self._demux, name="fastsync-v2",
+                                        daemon=True)
+        self._thread.start()
+
+    def switch_to_fast_sync(self, state) -> None:
+        self.state = state
+        self.initial_state = state
+        self.processor.state = state
+        self.scheduler.height = state.last_block_height + 1
+        self.fast_sync = True
+        self.start_sync()
+
+    def on_stop(self) -> None:
+        self._running = False
+
+    def wait_until_synced(self, timeout: float) -> bool:
+        return self._synced.wait(timeout)
+
+    def expects_peers(self) -> bool:
+        sw = self.switch
+        return bool(sw is not None and (sw.peers or sw._persistent_addrs))
+
+    # --- the demux routine (reference: reactor.go demux) --------------------
+
+    def _demux(self) -> None:
+        while self._running:
+            now = time.monotonic()
+            if self.switch is not None and now - self._last_status_bcast > 10.0:
+                self.switch.broadcast(BLOCKCHAIN_CHANNEL, msg_status_request())
+                self._last_status_bcast = now
+            if (not self.scheduler.peers
+                    and now - self._started_at > 15.0
+                    and not self.expects_peers()):
+                self._finish()  # solo node: nothing to sync from
+                return
+            try:
+                ev = self._events.get(timeout=0.05)
+            except queue.Empty:
+                ev = EvTick()
+            try:
+                self._route(ev)
+            except Exception as e:  # noqa: BLE001
+                if self.logger:
+                    self.logger.error("fastsync v2 event failed", err=e)
+            if self._synced.is_set():
+                return
+
+    def _route(self, ev) -> None:
+        if isinstance(ev, EvBlockResponse):
+            self.processor.add(ev.block, ev.peer_id)
+        if isinstance(ev, EvRemovePeer):
+            self.processor.purge_peer(ev.peer_id)
+        for act in self.scheduler.handle(ev):
+            self._apply_action(act)
+        if isinstance(ev, (EvBlockResponse, EvTick)):
+            for out in self.processor.try_process(self.scheduler.height):
+                self.state = self.processor.state
+                for act in self.scheduler.handle(out):
+                    self._apply_action(act)
+
+    def _apply_action(self, act: tuple) -> None:
+        kind = act[0]
+        if kind == "request":
+            _, peer_id, height = act
+            if self.switch is not None:
+                with self.switch._peers_mtx:
+                    p = self.switch.peers.get(peer_id)
+                if p is not None:
+                    p.try_send(BLOCKCHAIN_CHANNEL, msg_block_request(height))
+        elif kind == "drop_peer":
+            _, peer_id, reason = act
+            self.processor.purge_peer(peer_id)
+            if self.switch is not None:
+                self.switch.stop_peer_by_id(peer_id, reason)
+            self.scheduler.handle(EvRemovePeer(peer_id))
+        elif kind == "finished":
+            self._finish()
+
+    def _finish(self) -> None:
+        self._running = False
+        self._synced.set()
+        if self.consensus_reactor is not None:
+            self.consensus_reactor.switch_to_consensus(self.state)

@@ -170,6 +170,8 @@ class Node:
 
         if config.fastsync.version == "v1":
             from tendermint_tpu.blockchain.v1 import BlockchainReactorV1 as _BCR
+        elif config.fastsync.version == "v2":
+            from tendermint_tpu.blockchain.v2 import BlockchainReactorV2 as _BCR
         else:
             from tendermint_tpu.blockchain.reactor import BlockchainReactor as _BCR
         self.bc_reactor = _BCR(

@@ -182,3 +182,100 @@ def test_fastsync_v1_cold_node_catches_up(tmp_path):
             late.stop()
         n0.stop()
         n1.stop()
+
+
+def test_fastsync_v2_cold_node_catches_up(tmp_path):
+    """The routine-based v2 scheduler/processor syncs a cold node over real
+    sockets and hands off to consensus (reference: blockchain/v2/
+    scheduler.go, processor.go)."""
+    privs = [ed25519.gen_priv_key(bytes([65 + i]) * 32) for i in range(2)]
+    genesis = GenesisDoc(
+        chain_id="fsv2-chain", genesis_time=Time(1700002000, 0),
+        validators=[GenesisValidator(b"", p.pub_key(), 10) for p in privs],
+    )
+    n0 = _mk_node(tmp_path, "x0", genesis, privs[0])
+    n1 = _mk_node(tmp_path, "x1", genesis, privs[1])
+    n0.start()
+    n1.start()
+    late = None
+    try:
+        assert n1.switch.dial_peer(n0.p2p_addr()) is not None
+        assert _wait(lambda: n0.block_store.height >= 22, 90), n0.block_store.height
+
+        cfg = test_config()
+        cfg.set_root(str(tmp_path / "late-v2"))
+        os.makedirs(cfg.base.root_dir, exist_ok=True)
+        cfg.base.fast_sync_mode = True
+        cfg.fastsync.version = "v2"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.pex = False
+        cfg.p2p.persistent_peers = ",".join([n0.p2p_addr(), n1.p2p_addr()])
+        cfg.rpc.laddr = ""
+        cfg.consensus.wal_path = ""
+        late = Node(cfg, genesis=genesis, priv_validator=None,
+                    node_key=NodeKey(ed25519.gen_priv_key(b"\x69" * 32)))
+        from tendermint_tpu.blockchain.v2 import BlockchainReactorV2
+        assert isinstance(late.bc_reactor, BlockchainReactorV2)
+        late.start()
+        assert _wait(lambda: late.block_store.height >= 20, 90), late.block_store.height
+        assert late.block_store.load_block(12).hash() == \
+            n0.block_store.load_block(12).hash()
+        assert _wait(late.bc_reactor._synced.is_set, 60)
+        tip = n0.block_store.height
+        assert _wait(lambda: late.block_store.height >= tip + 2, 60)
+    finally:
+        if late is not None:
+            late.stop()
+        n0.stop()
+        n1.stop()
+
+
+def test_fastsync_v2_scheduler_unit():
+    """Scheduler planning: request fan-out, timeout retry, invalid-block
+    peer drop, finish detection (reference: scheduler_test.go shapes)."""
+    from tendermint_tpu.blockchain.v2 import (
+        EvBlockInvalid,
+        EvBlockProcessed,
+        EvBlockResponse,
+        EvRemovePeer,
+        EvStatus,
+        EvTick,
+        Scheduler,
+    )
+
+    s = Scheduler(initial_height=5)
+    acts = s.handle(EvStatus("pA", 1, 10))
+    reqs = [a for a in acts if a[0] == "request"]
+    assert reqs and all(5 <= a[2] <= 10 for a in reqs)
+    assert all(a[1] == "pA" for a in reqs)
+
+    # a second peer shares the load for new heights
+    s.handle(EvStatus("pB", 1, 12))
+    class _B:  # minimal block stand-in
+        def __init__(self, h):
+            self.header = type("H", (), {"height": h})()
+    s.handle(EvBlockResponse("pA", _B(5)))
+    assert 5 in s.received and 5 not in s.pending
+
+    # processed advances the window
+    acts = s.handle(EvBlockProcessed(5))
+    assert s.height == 6 and not any(a[0] == "finished" for a in acts)
+
+    # invalid block drops the peer
+    acts = s.handle(EvBlockInvalid(6, "pA"))
+    assert ("drop_peer", "pA", "invalid block") in acts
+    s.handle(EvRemovePeer("pA"))
+    assert "pA" not in s.peers
+
+    # timeout requeues: pretend a pending request is ancient
+    h, (p, _) = next(iter(s.pending.items()))
+    s.pending[h] = (p, 0.0)
+    s.handle(EvTick())
+    assert h in s.pending  # re-scheduled (possibly to the same surviving peer)
+
+    # finishing: processed past every peer's top
+    s.peers = {"pB": (1, 6)}
+    s.pending.clear()
+    s.received.clear()
+    acts = s.handle(EvBlockProcessed(6))
+    assert ("finished",) in acts
