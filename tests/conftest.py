@@ -8,11 +8,17 @@ too late; the backend is re-targeted via jax.config before any JAX op runs."""
 
 import os
 
+# TM_TPU_TEST_BACKEND=tpu keeps the session on the real chip (for the
+# on-chip tests like test_pallas_tpu.py); default is the CPU mesh.
+_KEEP_TPU = os.environ.get("TM_TPU_TEST_BACKEND") == "tpu"
+
 # The in-process jax.config updates below are what take effect for THIS
 # process; the env vars exist so child processes tests spawn (e2e runner,
 # node subprocesses) inherit the same CPU-mesh setup.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+if not _KEEP_TPU:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if not _KEEP_TPU and (
+        "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     ).strip()
@@ -20,11 +26,12 @@ if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
 import jax  # noqa: E402
 import jax._src.xla_bridge as _xb  # noqa: E402
 
-if _xb.backends_are_initialized():
-    # Some earlier import already ran a JAX op; start over in-process.
-    import jax.extend.backend as _jeb
+if not _KEEP_TPU:
+    if _xb.backends_are_initialized():
+        # Some earlier import already ran a JAX op; start over in-process.
+        import jax.extend.backend as _jeb
 
-    _jeb.clear_backends()
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
-assert jax.default_backend() == "cpu" and len(jax.devices()) == 8
+        _jeb.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+    assert jax.default_backend() == "cpu" and len(jax.devices()) == 8
