@@ -109,13 +109,16 @@ class Runner:
         # default_config already uses the durable sqlite backend, so
         # kill/restart exercises real recovery; nothing to patch.
 
-    def _spawn(self, i: int) -> subprocess.Popen:
+    def _spawn(self, i: int, home: str | None = None) -> subprocess.Popen:
         env = {**os.environ, "JAX_PLATFORMS": "cpu",
-               "TM_TPU_DISABLE_BATCH": os.environ.get("TM_TPU_DISABLE_BATCH", "")}
+               "TM_TPU_DISABLE_BATCH": os.environ.get("TM_TPU_DISABLE_BATCH", ""),
+               # serving nodes take app snapshots so late joiners can
+               # state-sync in (reference e2e: snapshot_interval manifest key)
+               "TMTPU_KVSTORE_SNAPSHOT_INTERVAL": "4"}
         log = open(os.path.join(self.workdir, f"node{i}.log"), "ab")
         return subprocess.Popen(
             [sys.executable, "-m", "tendermint_tpu.cli",
-             "--home", os.path.join(self.workdir, f"node{i}"), "start"],
+             "--home", home or os.path.join(self.workdir, f"node{i}"), "start"],
             stdout=log, stderr=log, env=env)
 
     def start(self) -> None:
@@ -210,6 +213,65 @@ class Runner:
                 continue
         assert len(hashes) >= 2, f"too few reachable nodes: {hashes}"
         assert len(set(hashes.values())) == 1, f"fork detected: {hashes}"
+
+    def join_statesync_node(self, timeout_s: float = 120.0) -> int:
+        """Spawn a NEW non-validator node that joins the live net via state
+        sync (snapshot bootstrap + light-client trust through node0's RPC),
+        then fast-syncs to the tip (reference: test/e2e 'stateSync' node
+        perturbation). Returns the joiner's node index."""
+        import shutil
+
+        from tendermint_tpu.cli.main import _ensure_dirs, default_config
+        from tendermint_tpu.config.toml import write_config_toml
+
+        idx = self.m.validators  # next slot
+        home = os.path.join(self.workdir, f"node{idx}")
+        _ensure_dirs(home)
+        # same genesis as the net
+        shutil.copy(os.path.join(self.workdir, "node0", "config", "genesis.json"),
+                    os.path.join(home, "config", "genesis.json"))
+        # trust anchor from node0 (height 2 hash via RPC)
+        meta = self._rpc(0, "block", {"height": "2"})
+        trust_hash = meta["block_id"]["hash"]
+
+        cfg = default_config().set_root(home)
+        base_port = self.m.starting_port + 2 * idx
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{base_port}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{base_port + 1}"
+        cfg.p2p.pex = False
+        peers = []
+        for i in range(self.m.validators):
+            try:
+                st = self._rpc(i, "status", {})
+                peers.append(f"{st['node_info']['id']}@127.0.0.1:"
+                             f"{self.m.starting_port + 2 * i}")
+            except Exception:  # noqa: BLE001
+                continue
+        cfg.p2p.persistent_peers = ",".join(peers)
+        cfg.base.fast_sync_mode = True
+        cfg.statesync.enable = True
+        cfg.statesync.rpc_servers = (self.rpc_addrs[0],)
+        cfg.statesync.trust_height = 2
+        cfg.statesync.trust_hash = trust_hash.lower()
+        cfg.statesync.trust_period_s = 10 * 365 * 24 * 3600.0
+        cfg.statesync.discovery_time_s = 1.0
+        write_config_toml(cfg, os.path.join(home, "config", "config.toml"))
+
+        self.rpc_addrs[idx] = f"http://127.0.0.1:{base_port + 1}"
+        self.procs[idx] = self._spawn(idx, home=home)
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                st = self._rpc(idx, "status", {})
+                h = int(st["sync_info"]["latest_block_height"])
+                base = int(st["sync_info"]["earliest_block_height"])
+                if h >= self.m.target_height and base > 1:
+                    return idx
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.5)
+        raise TimeoutError("joined node never state-synced to the tip")
 
     def stop(self) -> None:
         for i, proc in self.procs.items():

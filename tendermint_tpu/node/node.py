@@ -33,7 +33,10 @@ def default_app(name: str):
     if name.startswith(("tcp://", "unix://", "grpc://")):
         return name  # resolved to socket/grpc clients by abci.proxy.new_app_conns
     if name in ("kvstore", "persistent_kvstore"):
-        return KVStoreApplication()
+        # snapshot support for state-sync serving (the reference e2e app
+        # takes snapshot_interval from its manifest; env keeps the CLI thin)
+        interval = int(os.environ.get("TMTPU_KVSTORE_SNAPSHOT_INTERVAL", "0"))
+        return KVStoreApplication(snapshot_interval=interval)
     if name == "noop":
         from tendermint_tpu.abci.types import Application
 

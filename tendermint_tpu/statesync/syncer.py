@@ -101,6 +101,18 @@ class Syncer:
                 self.pool.reject(snapshot)
             except ErrAbort:
                 raise
+            except SyncError:
+                self.pool.reject(snapshot)
+            except Exception as e:  # noqa: BLE001
+                # Transient provider/light-client failure -- typically the
+                # trust chain can't serve app_hash(H) yet because header H+1
+                # hasn't landed on the RPC node. Retry the SAME snapshot
+                # until the deadline (reference: syncer retries discovery).
+                if self.logger:
+                    self.logger.info("state sync attempt failed; retrying",
+                                     err=e)
+                tried.discard(snapshot.key())
+                time.sleep(0.5)
         raise ErrNoSnapshots("no viable snapshot found before deadline")
 
     def sync(self, snapshot: Snapshot):

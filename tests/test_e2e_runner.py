@@ -34,6 +34,28 @@ def test_e2e_testnet_with_perturbations(tmp_path):
         r.stop()
 
 
+def test_e2e_statesync_join(tmp_path):
+    """A brand-new node process joins the running net via SNAPSHOT state
+    sync (light-client trust over node0's RPC), then fast-syncs to the tip
+    without ever replaying from genesis (reference: test/e2e state-sync
+    nodes)."""
+    m = Manifest(validators=4, chain_id="e2e-ss", target_height=9, load_txs=6)
+    r = Runner(m, str(tmp_path / "net"))
+    r.setup()
+    r.start()
+    try:
+        r.load()
+        r.perturb_and_wait(timeout_s=180)
+        idx = r.join_statesync_node(timeout_s=150)
+        st = r._rpc(idx, "status", {})
+        # bootstrapped mid-chain: no genesis replay
+        assert int(st["sync_info"]["earliest_block_height"]) > 1
+        # agrees with the net
+        r.assert_consistent(m.target_height - 1)
+    finally:
+        r.stop()
+
+
 def test_manifest_from_file(tmp_path):
     path = tmp_path / "manifest.json"
     path.write_text(json.dumps({
