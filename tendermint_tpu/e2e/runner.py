@@ -89,7 +89,7 @@ class Runner:
         self.procs: dict[int, subprocess.Popen | None] = {}
         self._paused: set[int] = set()
         if not self.m.starting_port:
-            self.m.starting_port = _free_port_base(2 * self.m.validators)
+            self.m.starting_port = _free_port_base(2 * (self.m.validators + 1))
         self.rpc_addrs = {
             i: f"http://127.0.0.1:{self.m.starting_port + 2 * i + 1}"
             for i in range(self.m.validators)
@@ -109,16 +109,17 @@ class Runner:
         # default_config already uses the durable sqlite backend, so
         # kill/restart exercises real recovery; nothing to patch.
 
-    def _spawn(self, i: int, home: str | None = None) -> subprocess.Popen:
+    def _spawn(self, i: int) -> subprocess.Popen:
         env = {**os.environ, "JAX_PLATFORMS": "cpu",
                "TM_TPU_DISABLE_BATCH": os.environ.get("TM_TPU_DISABLE_BATCH", ""),
                # serving nodes take app snapshots so late joiners can
                # state-sync in (reference e2e: snapshot_interval manifest key)
-               "TMTPU_KVSTORE_SNAPSHOT_INTERVAL": "4"}
+               "TMTPU_KVSTORE_SNAPSHOT_INTERVAL":
+                   os.environ.get("TMTPU_KVSTORE_SNAPSHOT_INTERVAL", "4")}
         log = open(os.path.join(self.workdir, f"node{i}.log"), "ab")
         return subprocess.Popen(
             [sys.executable, "-m", "tendermint_tpu.cli",
-             "--home", home or os.path.join(self.workdir, f"node{i}"), "start"],
+             "--home", os.path.join(self.workdir, f"node{i}"), "start"],
             stdout=log, stderr=log, env=env)
 
     def start(self) -> None:
@@ -194,7 +195,7 @@ class Runner:
 
     def max_height(self) -> int:
         best = 0
-        for i in range(self.m.validators):
+        for i in list(self.rpc_addrs):
             try:
                 st = self._rpc(i, "status", {})
                 best = max(best, int(st["sync_info"]["latest_block_height"]))
@@ -205,7 +206,7 @@ class Runner:
     def assert_consistent(self, height: int) -> None:
         """All reachable nodes agree on the block hash at `height`."""
         hashes = {}
-        for i in range(self.m.validators):
+        for i in list(self.rpc_addrs):
             try:
                 b = self._rpc(i, "block", {"height": str(height)})
                 hashes[i] = b["block_id"]["hash"]
@@ -258,7 +259,7 @@ class Runner:
         write_config_toml(cfg, os.path.join(home, "config", "config.toml"))
 
         self.rpc_addrs[idx] = f"http://127.0.0.1:{base_port + 1}"
-        self.procs[idx] = self._spawn(idx, home=home)
+        self.procs[idx] = self._spawn(idx)
 
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:

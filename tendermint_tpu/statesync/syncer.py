@@ -77,6 +77,7 @@ class Syncer:
         Returns (state, commit)."""
         deadline = time.monotonic() + give_up_after_s
         tried: set[bytes] = set()
+        transient_retries: dict[bytes, int] = {}
         while time.monotonic() < deadline:
             snapshot = None
             for s in self.pool.ranked():
@@ -106,13 +107,19 @@ class Syncer:
             except Exception as e:  # noqa: BLE001
                 # Transient provider/light-client failure -- typically the
                 # trust chain can't serve app_hash(H) yet because header H+1
-                # hasn't landed on the RPC node. Retry the SAME snapshot
-                # until the deadline (reference: syncer retries discovery).
+                # hasn't landed on the RPC node. Retry the SAME snapshot a
+                # few times, then reject it so lower-ranked snapshots get a
+                # turn (a deterministic failure must not starve them).
+                n = transient_retries.get(snapshot.key(), 0) + 1
+                transient_retries[snapshot.key()] = n
                 if self.logger:
-                    self.logger.info("state sync attempt failed; retrying",
-                                     err=e)
-                tried.discard(snapshot.key())
-                time.sleep(0.5)
+                    self.logger.info("state sync attempt failed",
+                                     err=e, attempt=n)
+                if n < 6:
+                    tried.discard(snapshot.key())
+                    time.sleep(0.5)
+                else:
+                    self.pool.reject(snapshot)
         raise ErrNoSnapshots("no viable snapshot found before deadline")
 
     def sync(self, snapshot: Snapshot):
