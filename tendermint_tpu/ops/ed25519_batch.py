@@ -474,14 +474,14 @@ def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
     # Non-decompressable keys get an identity comb table; they must be
     # rejected here, exactly as the scalar path's _decompress(pub) is None.
     pub_ok = pub_ok & ks.valid[key_idx]
-    use_pallas = _use_pallas()
-    s = prepare_scalars(items, pub_ok, windows=not use_pallas)
-
-    if use_pallas:
+    if _use_pallas():
+        # Prep is done chunk-by-chunk inside the pipelined path so device
+        # compute overlaps host prep of the next chunk.
         from tendermint_tpu.ops import ed25519_pallas
 
-        ok = ed25519_pallas.verify_with_keyset(ks, key_idx, s)
+        ok = ed25519_pallas.verify_items_pipelined(ks, key_idx, items, pub_ok)
         return np.asarray(ok)[:n].astype(bool)
+    s = prepare_scalars(items, pub_ok, windows=True)
 
     # Fixed-tile chunking: every batch runs through the one JNP_TILE-shaped
     # executable, so no batch size ever triggers a fresh XLA compile.

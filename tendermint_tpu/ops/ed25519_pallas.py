@@ -433,36 +433,34 @@ if CHUNK % TILE != 0 or CHUNK <= 0:
         f"TM_TPU_PALLAS_CHUNK must be a positive multiple of TILE={TILE}, got {CHUNK}")
 
 
-def verify_with_keyset(ks, key_idx: np.ndarray, s: dict) -> np.ndarray:
-    """High-level entry used by ed25519_batch.verify_batch on TPU backends.
+def verify_items_pipelined(ks, key_idx: np.ndarray, items, pub_ok) -> np.ndarray:
+    """Chunk-pipelined verify: host prep of chunk i+1 overlaps device
+    compute of chunk i (dispatches are async; the single blocking readback
+    is at the end). On the 1-core host this hides min(prep, device) per
+    chunk versus the prep-everything-then-dispatch path."""
+    from tendermint_tpu.ops import ed25519_batch as edb
 
-    ks: ed25519_batch.KeySet; key_idx (n,) int32; s: prepare_scalars output
-    (unpadded, with raw h32/s32 scalars). Returns (n,) bool.
-
-    Per chunk the host ships 97 bytes/sig (h32+s32+r32+valid) as contiguous
-    uint8 blocks; windows and R limb-splitting happen on device. All chunk
-    dispatches are async -- device compute of chunk i overlaps host staging
-    of chunk i+1 -- with one blocking readback at the end."""
-    n = key_idx.shape[0]
-    nb = -(-n // CHUNK) * CHUNK
-
-    idx = np.zeros((nb,), dtype=np.int32)
-    idx[:n] = key_idx
-
-    def pad_cols(x, rows):
-        out = np.zeros((rows, nb), dtype=np.uint8)
-        out[:, :n] = x.T if x.ndim == 2 else x[None, :]
-        return out
-
-    h32 = jnp.asarray(pad_cols(s["h32"], 32))
-    s32 = jnp.asarray(pad_cols(s["s32"], 32))
-    r32 = jnp.asarray(pad_cols(s["r32"], 32))
-    valid = jnp.asarray(pad_cols(s["valid"].astype(np.uint8), 1))
-
+    n = len(items)
     outs = []
-    for off in range(0, nb, CHUNK):
-        tab = ks.gathered_lane(idx[off:off + CHUNK])  # cached per pattern
-        outs.append(_verify_chunk_at(
-            tab, h32, s32, r32, valid, jnp.int32(off)))
+    for off in range(0, n, CHUNK):
+        sl = slice(off, min(off + CHUNK, n))
+        s = edb.prepare_scalars(items[sl], pub_ok[sl], windows=False)
+        cn = sl.stop - sl.start
+        idx = np.zeros((CHUNK,), dtype=np.int32)
+        idx[:cn] = key_idx[sl]
+
+        def pad_cols(x, rows):
+            out = np.zeros((rows, CHUNK), dtype=np.uint8)
+            out[:, :cn] = x.T if x.ndim == 2 else x[None, :]
+            return out
+
+        tab = ks.gathered_lane(idx)
+        outs.append(_verify_chunk(
+            tab,
+            jnp.asarray(pad_cols(s["h32"], 32)),
+            jnp.asarray(pad_cols(s["s32"], 32)),
+            jnp.asarray(pad_cols(s["r32"], 32)),
+            jnp.asarray(pad_cols(s["valid"].astype(np.uint8), 1)),
+        ))
     ok = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
     return np.asarray(ok)[0, :n].astype(bool)
