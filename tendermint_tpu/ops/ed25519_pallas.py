@@ -407,20 +407,6 @@ def _verify_chunk(tab, h32, s32, r32, valid):
     return _pallas_verify(tab, hw, sw, r_y, r_sv)
 
 
-@jax.jit
-def _verify_chunk_at(tab, h32, s32, r32, valid, off):
-    """Chunk slicing moved on-device: the FULL padded batch uploads once
-    (4 arrays), each chunk slices at a traced offset. One executable per
-    padded batch width (consensus batch sizes are stable height to height,
-    and the persistent compile cache covers restarts); per-call H2D drops
-    from 4*n_chunks transfers to 4."""
-    h = jax.lax.dynamic_slice_in_dim(h32, off, CHUNK, axis=1)
-    s = jax.lax.dynamic_slice_in_dim(s32, off, CHUNK, axis=1)
-    r = jax.lax.dynamic_slice_in_dim(r32, off, CHUNK, axis=1)
-    v = jax.lax.dynamic_slice_in_dim(valid, off, CHUNK, axis=1)
-    return _verify_chunk(tab, h, s, r, v)
-
-
 # Fixed dispatch shape: XLA compiles one executable per input shape, so the
 # pallas call always runs at a multiple of CHUNK lanes (small batches pad to
 # one CHUNK; large ones loop). A fresh batch size must never trigger a cold
