@@ -187,3 +187,66 @@ def test_mixed_batch_verifier_routes_by_type():
     all_ok, bitmap = v.verify()
     assert bitmap == expect
     assert all_ok == all(expect)
+
+
+def test_mixed_key_validator_set_commit_verify():
+    """BASELINE config 4 shape at the types layer: a validator set mixing
+    ed25519 (batched) and secp256k1 (scalar fallback) keys verifies commits
+    through the MixedBatchVerifier with exact accept/reject attribution.
+    (sr25519 is sign-layer only: the v0.34 PublicKey proto has no sr25519
+    field -- reference proto/tendermint/crypto/keys.proto:13-16.)"""
+    from tendermint_tpu.types.block import Commit, CommitSig
+    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+    from tendermint_tpu.types.ttime import Time
+    from tendermint_tpu.types.validator import Validator
+    from tendermint_tpu.types.validator_set import (
+        ErrWrongSignature,
+        ValidatorSet,
+    )
+    from tendermint_tpu.types.vote import BLOCK_ID_FLAG_COMMIT, PRECOMMIT_TYPE, Vote
+
+    chain_id = "mixed-chain"
+    pairs = []
+    for i in range(6):
+        if i % 3 == 2:
+            priv = secp256k1.gen_priv_key(bytes([i + 1]) * 32)
+        else:
+            priv = ed25519.gen_priv_key(bytes([i + 1]) * 32)
+        pairs.append((priv, Validator.new(priv.pub_key(), 10)))
+    vs = ValidatorSet([v for _, v in pairs])
+    by_addr = {v.address: p for p, v in pairs}
+    privs = [by_addr[v.address] for v in vs.validators]
+
+    # wire round-trip keeps both key types
+    vs2 = ValidatorSet.unmarshal(vs.marshal())
+    assert [v.pub_key.type for v in vs2.validators] == \
+        [v.pub_key.type for v in vs.validators]
+
+    bid = BlockID(hash=b"\xa1" * 32,
+                  part_set_header=PartSetHeader(total=1, hash=b"\xb1" * 32))
+    sigs = []
+    for i, (priv, val) in enumerate(zip(privs, vs.validators)):
+        ts = Time(1700000500 + i, 0)
+        vote = Vote(type=PRECOMMIT_TYPE, height=9, round=0, block_id=bid,
+                    timestamp=ts, validator_address=val.address,
+                    validator_index=i)
+        sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, val.address, ts,
+                              priv.sign(vote.sign_bytes(chain_id))))
+    commit = Commit(height=9, round=0, block_id=bid, signatures=sigs)
+    vs.verify_commit(chain_id, bid, 9, commit)
+    vs.verify_commit_light(chain_id, bid, 9, commit)
+    vs.verify_commit_light_trusting(chain_id, commit, (1, 3))
+
+    # corrupt a secp256k1 signature: exact index attribution survives mixing
+    secp_idx = next(i for i, v in enumerate(vs.validators)
+                    if v.pub_key.type == "secp256k1")
+    bad = sigs[secp_idx].signature
+    sigs[secp_idx] = CommitSig(BLOCK_ID_FLAG_COMMIT,
+                               vs.validators[secp_idx].address,
+                               sigs[secp_idx].timestamp,
+                               bad[:-1] + bytes([bad[-1] ^ 1]))
+    commit2 = Commit(height=9, round=0, block_id=bid, signatures=sigs)
+    import pytest
+    with pytest.raises(ErrWrongSignature) as ei:
+        vs.verify_commit(chain_id, bid, 9, commit2)
+    assert ei.value.index == secp_idx
