@@ -267,10 +267,15 @@ class BlockchainReactor(Reactor):
                 self.state.chain_id, first_id, first.header.height, second.last_commit
             )
         except Exception as e:  # noqa: BLE001
+            # Punish BOTH senders: the bad LastCommit is carried by the
+            # second block (reference: blockchain/v0/reactor.go:394-408).
             bad = self.pool.redo_request(first.header.height)
-            if self.switch is not None and bad in self.switch.peers:
-                self.switch.stop_peer_for_error(self.switch.peers[bad],
-                                                f"invalid block: {e}")
+            bad2 = self.pool.redo_request(first.header.height + 1)
+            if self.switch is not None:
+                for pid in {bad, bad2} - {None}:
+                    if pid in self.switch.peers:
+                        self.switch.stop_peer_for_error(
+                            self.switch.peers[pid], f"invalid block: {e}")
             return
         self.pool.pop_request()
         self.block_store.save_block(first, first_parts, second.last_commit)

@@ -267,9 +267,13 @@ class BlockchainReactorV1(Reactor):
                 self.state.chain_id, first_id, first.header.height,
                 second.last_commit)
         except Exception as e:  # noqa: BLE001
+            # The invalid LastCommit rides in the SECOND block: punish both
+            # senders (reference: blockchain/v1/reactor.go processBlock
+            # failure path redoes first.Height and first.Height+1).
             bad = self.pool.redo_request(first.header.height)
-            if bad:
-                self.drop_peer(bad, f"invalid block: {e}")
+            bad2 = self.pool.redo_request(first.header.height + 1)
+            for pid in {bad, bad2} - {None}:
+                self.drop_peer(pid, f"invalid block: {e}")
             return False
         self.pool.pop_request()
         self.block_store.save_block(first, first_parts, second.last_commit)

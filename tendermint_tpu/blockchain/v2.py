@@ -115,11 +115,21 @@ class Scheduler:
                 del self.pending[h]
         elif isinstance(ev, EvBlockResponse):
             h = ev.block.header.height
-            self.pending.pop(h, None)
-            self.received.add(h)
+            if self.solicited(ev.peer_id, h):
+                self.pending.pop(h, None)
+                self.received.add(h)
+            # else: unsolicited -- IGNORED, not punished. The reference
+            # scheduler validates responses against pendingBlocks
+            # (blockchain/v2/scheduler.go handleBlockResponse) so a peer
+            # cannot clear others' pending slots or pin arbitrary data; we
+            # don't drop the sender because a timeout reassignment makes a
+            # late HONEST response indistinguishable from a malicious one.
         elif isinstance(ev, EvNoBlock):
-            self.pending.pop(ev.height, None)
-            acts.append(("drop_peer", ev.peer_id, "no block for advertised height"))
+            if self.solicited(ev.peer_id, ev.height):
+                self.pending.pop(ev.height, None)
+                acts.append(("drop_peer", ev.peer_id,
+                             "no block for advertised height"))
+            # else: stale/unsolicited NoBlock -- same reasoning as above.
         elif isinstance(ev, EvBlockProcessed):
             self.height = ev.height + 1
             self.received.discard(ev.height)
@@ -132,14 +142,33 @@ class Scheduler:
             self.received.discard(ev.height)
         elif isinstance(ev, EvTick):
             now = time.monotonic()
+            timed_out: set[str] = set()
             for h, (p, at) in list(self.pending.items()):
                 if now - at > REQUEST_TIMEOUT_S:
-                    del self.pending[h]  # retry elsewhere
+                    del self.pending[h]
+                    timed_out.add(p)
+            # Drop the timed-out peer entirely (reference scheduler
+            # peer-timeout semantics): silently reassigning its heights
+            # would make its late honest response look unsolicited.
+            for p in timed_out:
+                acts.append(("drop_peer", p, "block request timeout"))
             if self.caught_up():
                 acts.append(("finished",))
                 return acts
         acts.extend(self._schedule())
         return acts
+
+    def solicited(self, peer_id: str, height: int) -> bool:
+        """True iff `height` is pending from exactly this peer."""
+        pend = self.pending.get(height)
+        return pend is not None and pend[0] == peer_id
+
+    def forget(self, heights) -> None:
+        """Purged buffered blocks must leave `received` too, or _schedule
+        skips their heights forever and sync deadlocks."""
+        for h in heights:
+            self.received.discard(h)
+            self.pending.pop(h, None)
 
     def caught_up(self) -> bool:
         """v0 semantics (pool.is_caught_up): next height to sync has reached
@@ -179,9 +208,13 @@ class Processor:
     def add(self, block: Block, peer_id: str) -> None:
         self.blocks[block.header.height] = (block, peer_id)
 
-    def purge_peer(self, peer_id: str) -> None:
-        for h in [h for h, (_, p) in self.blocks.items() if p == peer_id]:
+    def purge_peer(self, peer_id: str) -> list[int]:
+        """Drop this peer's buffered blocks; returns the purged heights so
+        the scheduler can forget them (received-set hygiene)."""
+        hs = [h for h, (_, p) in self.blocks.items() if p == peer_id]
+        for h in hs:
             del self.blocks[h]
+        return hs
 
     def try_process(self, height: int) -> list:
         """Process as many contiguous (first, second) pairs as available
@@ -206,8 +239,16 @@ class Processor:
                     self.state.chain_id, first_id, block.header.height,
                     sec.last_commit)
             except Exception:  # noqa: BLE001
-                del self.blocks[height]
+                # The invalid LastCommit is carried by the SECOND block, so
+                # both peers are suspect: purge both blocks and punish both
+                # (reference: blockchain/v2/processor.go:170-176). An event
+                # is emitted for EACH height so the scheduler forgets both
+                # from `received` even when one peer served both blocks.
+                second_peer = second[1]
+                self.blocks.pop(height, None)
+                self.blocks.pop(height + 1, None)
                 events.append(EvBlockInvalid(height, peer_id))
+                events.append(EvBlockInvalid(height + 1, second_peer))
                 return events
             del self.blocks[height]
             self.block_store.save_block(block, first_parts, sec.last_commit)
@@ -343,9 +384,10 @@ class BlockchainReactorV2(Reactor):
 
     def _route(self, ev) -> None:
         if isinstance(ev, EvBlockResponse):
-            self.processor.add(ev.block, ev.peer_id)
+            if self.scheduler.solicited(ev.peer_id, ev.block.header.height):
+                self.processor.add(ev.block, ev.peer_id)
         if isinstance(ev, EvRemovePeer):
-            self.processor.purge_peer(ev.peer_id)
+            self.scheduler.forget(self.processor.purge_peer(ev.peer_id))
         for act in self.scheduler.handle(ev):
             self._apply_action(act)
         if isinstance(ev, (EvBlockResponse, EvTick)):
@@ -365,7 +407,7 @@ class BlockchainReactorV2(Reactor):
                     p.try_send(BLOCKCHAIN_CHANNEL, msg_block_request(height))
         elif kind == "drop_peer":
             _, peer_id, reason = act
-            self.processor.purge_peer(peer_id)
+            self.scheduler.forget(self.processor.purge_peer(peer_id))
             if self.switch is not None:
                 self.switch.stop_peer_by_id(peer_id, reason)
             self.scheduler.handle(EvRemovePeer(peer_id))

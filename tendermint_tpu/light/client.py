@@ -258,12 +258,15 @@ class Client:
         self._verify_skipping(self.primary, trusted, new_lb, now)
 
     def _verify_skipping(
-        self, source: Provider, trusted: LightBlock, new_lb: LightBlock, now: Time
+        self, source: Provider, trusted: LightBlock, new_lb: LightBlock,
+        now: Time, save: bool = True
     ) -> list[LightBlock]:
         """Bisection (reference: light/client.go:706 verifySkipping).
 
         Maintains a stack of pending blocks; on ErrNewValSetCantBeTrusted,
-        fetch the midpoint and retry against it.
+        fetch the midpoint and retry against it. With save=False nothing is
+        written to the trusted store (the detector substantiates a witness's
+        divergent header without polluting trust).
         """
         block_cache = [new_lb]
         verified_blocks = []
@@ -307,7 +310,7 @@ class Client:
                 return verified_blocks
             verified = candidate
             verified_blocks.append(candidate)
-            if candidate.height != new_lb.height:
+            if save and candidate.height != new_lb.height:
                 self.trusted_store.save_light_block(candidate)
             depth = 0
             block_cache = [b for b in block_cache if b.height > candidate.height]

@@ -92,20 +92,39 @@ def detect_divergence(client, new_lb: LightBlock, now: Time) -> None:
         if lb.hash() != sh.hash():
             conflicts.append(ErrConflictingHeaders(lb, i))
 
-    for c in conflicts:
-        _handle_conflicting_headers(client, c, new_lb, now)
+    substantiated = [c for c in conflicts
+                     if _handle_conflicting_headers(client, c, new_lb, now)]
     for i in reversed(sorted(set(dead + [c.witness_index for c in conflicts]))):
         if i < len(client.witnesses):
             client.remove_witness(i)
-    if conflicts:
+    if substantiated:
         # The reference errors out so the caller re-examines trust
-        # (light/detector.go:95-113); surface the first conflict.
-        raise conflicts[0]
+        # (light/detector.go:95-113); surface the first substantiated
+        # conflict. Witnesses that could NOT prove their divergent header
+        # from the common ancestor were merely dropped above — a single
+        # lying witness must not fail an otherwise-valid verification
+        # (reference: light/detector.go:105-110).
+        raise substantiated[0]
+
+
+def _substantiate(client, witness, common: LightBlock, target: LightBlock,
+                  now: Time) -> bool:
+    """Can the witness prove its divergent header from the common trusted
+    ancestor? Runs the client's skipping bisection against the WITNESS with
+    save=False (nothing enters the trusted store); any verification or
+    provider failure means unsubstantiated (reference:
+    light/detector.go:120-160 examineConflictingHeaderAgainstTrace)."""
+    try:
+        client._verify_skipping(witness, common, target, now, save=False)
+        return True
+    except Exception:  # noqa: BLE001 - any failure = unsubstantiated
+        return False
 
 
 def _handle_conflicting_headers(client, conflict: ErrConflictingHeaders,
-                                primary_block: LightBlock, now: Time) -> None:
-    """Build and report evidence for one divergence (reference:
+                                primary_block: LightBlock, now: Time) -> bool:
+    """Build and report evidence for one divergence; returns True iff the
+    witness substantiated its conflicting header (reference:
     light/detector.go:116 compareNewHeaderWithWitness +
     examineConflictingHeaderAgainstTrace)."""
     witness = client.witnesses[conflict.witness_index]
@@ -113,9 +132,13 @@ def _handle_conflicting_headers(client, conflict: ErrConflictingHeaders,
     if common is None or common.height >= primary_block.height:
         common = client.trusted_store.light_block_before(primary_block.height)
     if common is None:
-        return
+        return False
 
     witness_block = conflict.block
+    if not _substantiate(client, witness, common, witness_block, now):
+        # Faulty/lying witness that can't back its header: caller drops it
+        # and verification continues (reference: detector.go:105-110).
+        return False
     # Evidence against whichever chain diverges from the common ancestor:
     # report both directions; honest full nodes discard the invalid one
     # (reference: light/detector.go:135-176 gatherEvidence).
@@ -129,6 +152,7 @@ def _handle_conflicting_headers(client, conflict: ErrConflictingHeaders,
             target.report_evidence(ev)
         except ProviderError:
             pass
+    return True
 
 
 def make_attack_evidence(common: LightBlock,

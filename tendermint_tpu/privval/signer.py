@@ -73,8 +73,14 @@ def _read_msg(rfile) -> bytes | None:
 
 
 def _pubkey_marshal(pub: keys.PubKey) -> bytes:
-    # crypto proto PublicKey oneof: ed25519=1, secp256k1=2
-    fieldnum = {"ed25519": 1, "secp256k1": 2}.get(pub.type, 1)
+    # crypto proto PublicKey oneof: ed25519=1, secp256k1=2 (reference:
+    # proto/tendermint/crypto/keys.proto). Any other key type (sr25519) is
+    # NOT representable -- defaulting to field 1 would make the node
+    # unmarshal it as ed25519: wrong address, every verify fails silently.
+    fieldnum = {"ed25519": 1, "secp256k1": 2}.get(pub.type)
+    if fieldnum is None:
+        raise ValueError(
+            f"key type {pub.type!r} not representable in the PublicKey oneof")
     return proto.Writer().bytes(fieldnum, pub.bytes()).out()
 
 
@@ -193,8 +199,16 @@ class SignerServer:
         f = proto.fields(buf)
         w = proto.Writer()
         if 1 in f:  # PubKeyRequest
-            pub = self.pv.get_pub_key()
-            inner = proto.Writer().message(1, _pubkey_marshal(pub), always=True).out()
+            try:
+                pub = self.pv.get_pub_key()
+                inner = proto.Writer().message(
+                    1, _pubkey_marshal(pub), always=True).out()
+            except Exception as e:  # noqa: BLE001 - e.g. non-proto key type
+                # Reply with the PubKeyResponse error field: raising here
+                # would close the socket and silently re-dial forever.
+                inner = proto.Writer().message(
+                    2, _error_marshal(RemoteSignerError(4, str(e))),
+                    always=True).out()
             return w.message(2, inner, always=True).out()
         if 3 in f:  # SignVoteRequest
             m = proto.fields(f[3][-1])

@@ -63,24 +63,27 @@ class LightClientStateProvider:
         """Reconstruct the post-block-`height` State from verified headers
         (reference: stateprovider.go:100-140)."""
         with self._mtx:
-            cur = self._light_block(height)
-            nxt = self._light_block(height + 1)
-            prev = None
-            if height > self.initial_height:
-                prev = self._light_block(height - 1)
+            # State convention (state/state.py:29): validators apply at
+            # last_block_height+1, next_validators at +2, last_validators at
+            # the committed height itself.  Light block at X carries
+            # valset(X), so fetch H, H+1, H+2 (reference:
+            # statesync/stateprovider.go:146-170).
+            last = self._light_block(height)
+            cur = self._light_block(height + 1)
+            nxt = self._light_block(height + 2)
             return State(
                 version=Consensus(block=BLOCK_PROTOCOL, app=self.version_app),
                 chain_id=self.chain_id,
                 initial_height=self.initial_height,
-                last_block_height=cur.height,
-                last_block_id=nxt.signed_header.header.last_block_id,
-                last_block_time=cur.signed_header.header.time,
+                last_block_height=last.height,
+                last_block_id=cur.signed_header.header.last_block_id,
+                last_block_time=last.signed_header.header.time,
                 validators=cur.validator_set,
                 next_validators=nxt.validator_set,
-                last_validators=prev.validator_set if prev else None,
-                last_height_validators_changed=cur.height,
+                last_validators=last.validator_set,
+                last_height_validators_changed=nxt.height,
                 consensus_params=self.consensus_params,
                 last_height_consensus_params_changed=self.initial_height,
-                last_results_hash=nxt.signed_header.header.last_results_hash,
-                app_hash=nxt.signed_header.header.app_hash,
+                last_results_hash=cur.signed_header.header.last_results_hash,
+                app_hash=cur.signed_header.header.app_hash,
             )
