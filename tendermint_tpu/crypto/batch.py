@@ -67,9 +67,13 @@ def batch_min() -> int:
     return int(v) if v else 32
 
 
-class Ed25519BatchVerifier(BatchVerifier):
-    """TPU-batched ed25519 (tendermint_tpu.ops.ed25519_batch), with a scalar
-    fallback for batches too small to amortize a kernel launch."""
+class _KernelBatchVerifier(BatchVerifier):
+    """Shared body of the TPU-batched verifiers: a scalar fallback below
+    batch_min (a kernel launch never pays off for a handful of sigs), the
+    kernel dispatch, and metrics. Subclasses name the scalar + ops modules."""
+
+    _scalar_module: str
+    _ops_module: str
 
     def __init__(self) -> None:
         self._items: list[tuple[bytes, bytes, bytes]] = []
@@ -78,19 +82,20 @@ class Ed25519BatchVerifier(BatchVerifier):
         self._items.append((pub_key.bytes(), msg, sig))
 
     def verify(self) -> tuple[bool, list[bool]]:
+        import importlib
+
         items, self._items = self._items, []
         if len(items) < batch_min():
-            from tendermint_tpu.crypto import ed25519
-
-            out = [ed25519.verify(p, m, s) for (p, m, s) in items]
+            scalar = importlib.import_module(self._scalar_module)
+            out = [scalar.verify(p, m, s) for (p, m, s) in items]
             return all(out), out
         import time as _t
 
-        from tendermint_tpu.ops import ed25519_batch
         from tendermint_tpu.utils import metrics as tmmetrics
 
+        ops = importlib.import_module(self._ops_module)
         started = _t.monotonic()
-        bitmap = ed25519_batch.verify_batch(items)
+        bitmap = ops.verify_batch(items)
         out = [bool(b) for b in bitmap]
         if tmmetrics.GLOBAL_NODE_METRICS is not None:
             m = tmmetrics.GLOBAL_NODE_METRICS
@@ -100,6 +105,22 @@ class Ed25519BatchVerifier(BatchVerifier):
 
     def __len__(self) -> int:
         return len(self._items)
+
+
+class Ed25519BatchVerifier(_KernelBatchVerifier):
+    """TPU-batched ed25519 (tendermint_tpu.ops.ed25519_batch)."""
+
+    _scalar_module = "tendermint_tpu.crypto.ed25519"
+    _ops_module = "tendermint_tpu.ops.ed25519_batch"
+
+
+class Sr25519BatchVerifier(_KernelBatchVerifier):
+    """TPU-batched sr25519 (tendermint_tpu.ops.sr25519_batch): the Edwards
+    comb kernel with merlin challenges batched in C. The reference verifies
+    sr25519 serially through go-schnorrkel (crypto/sr25519/pubkey.go:10)."""
+
+    _scalar_module = "tendermint_tpu.crypto.sr25519"
+    _ops_module = "tendermint_tpu.ops.sr25519_batch"
 
 
 class MixedBatchVerifier(BatchVerifier):
@@ -198,3 +219,4 @@ def _ensure() -> None:
         _BATCH_TYPES["_disabled"] = ScalarBatchVerifier
         return
     _BATCH_TYPES["ed25519"] = Ed25519BatchVerifier
+    _BATCH_TYPES["sr25519"] = Sr25519BatchVerifier

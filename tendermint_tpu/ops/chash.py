@@ -19,13 +19,16 @@ import threading
 import numpy as np
 
 _CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
-_SRC_PATH = os.path.abspath(os.path.join(_CSRC, "hash_batch.c"))
+_SRC_PATHS = [
+    os.path.abspath(os.path.join(_CSRC, "hash_batch.c")),
+    os.path.abspath(os.path.join(_CSRC, "sr25519_strobe.c")),
+]
 _HDR_PATH = os.path.abspath(os.path.join(_CSRC, "hash_consts.h"))
 
 
 def _lib_path() -> str:
     h = hashlib.sha256()
-    for p in (_SRC_PATH, _HDR_PATH):
+    for p in _SRC_PATHS + [_HDR_PATH]:
         with open(p, "rb") as f:
             h.update(f.read())
     return os.path.abspath(
@@ -43,7 +46,7 @@ _I32P = ctypes.POINTER(ctypes.c_int32)
 def _build(lib_path: str) -> bool:
     tmp = lib_path + ".tmp"
     for flags in (["-fopenmp"], []):
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-x", "c", _SRC_PATH,
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-x", "c", *_SRC_PATHS,
                "-o", tmp] + flags
         try:
             r = subprocess.run(cmd, capture_output=True, timeout=120)
@@ -78,6 +81,10 @@ def _load() -> ctypes.CDLL | None:
         lib.sha256_batch.argtypes = [_U8P, _I64P, _I32P, ctypes.c_int64, _U8P]
         lib.sha256_batch_fixed.argtypes = [
             _U8P, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64, _U8P]
+        lib.sr25519_challenge_batch.argtypes = [
+            _U8P, ctypes.c_int32, ctypes.c_int32,
+            _U8P, _I64P, _I32P, _U8P, _U8P, ctypes.c_int64, _U8P,
+        ]
         _lib = lib
         return _lib
 
@@ -149,6 +156,34 @@ def sha256_many(msgs: list[bytes]) -> np.ndarray:
     buf = np.frombuffer(data, dtype=np.uint8) if data else np.zeros(1, dtype=np.uint8)
     lib.sha256_batch(_u8(buf), offs.ctypes.data_as(_I64P),
                      lens.ctypes.data_as(_I32P), n, _u8(out))
+    return out
+
+
+def sr25519_challenges(prefix_state: bytes, prefix_pos: int,
+                       prefix_pos_begin: int, msgs: list[bytes],
+                       pubs: np.ndarray, rs: np.ndarray) -> np.ndarray | None:
+    """Batched schnorrkel verify challenges -> (N, 64) uint8 pre-reduction
+    transcript PRF bytes, or None when the C library is unavailable (caller
+    falls back to the pure-Python transcript).
+
+    prefix_state/pos/pos_begin: the Strobe state of the transcript prefix
+    shared by every signature (SigningContext + empty context label), computed
+    once in Python. pubs, rs: C-contiguous (N, 32) uint8 arrays."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(msgs)
+    out = np.empty((n, 64), dtype=np.uint8)
+    data = b"".join(msgs)
+    lens = np.fromiter((len(m) for m in msgs), dtype=np.int32, count=n)
+    offs = np.zeros(n, dtype=np.int64)
+    np.cumsum(lens[:-1], out=offs[1:])
+    buf = np.frombuffer(data, dtype=np.uint8) if data else np.zeros(1, dtype=np.uint8)
+    st = np.frombuffer(prefix_state, dtype=np.uint8)
+    lib.sr25519_challenge_batch(
+        _u8(st), prefix_pos, prefix_pos_begin, _u8(buf),
+        offs.ctypes.data_as(_I64P), lens.ctypes.data_as(_I32P),
+        _u8(pubs), _u8(rs), n, _u8(out))
     return out
 
 

@@ -320,14 +320,19 @@ def _normalize_pubs(pubs: list[bytes]) -> tuple[bytes, np.ndarray]:
     return b"".join(p if len(p) == 32 else zero for p in pubs), ok
 
 
-def get_keyset(pubs: list[bytes]) -> tuple[KeySet, np.ndarray, np.ndarray]:
-    """-> (KeySet, key_idx (N,) int32, pub_ok (N,) bool). Cached by the exact
-    pubkey byte sequence; steady-state consensus hits the cache every height."""
+def build_keyset(pubs: list[bytes], cache: OrderedDict, lock: threading.Lock,
+                 decode_neg) -> tuple[KeySet, np.ndarray, np.ndarray]:
+    """Shared key-set machinery for any Edwards-comb key type.
+
+    -> (KeySet, key_idx (N,) int32, pub_ok (N,) bool). Cached by the exact
+    pubkey byte sequence; steady-state consensus hits the cache every height.
+    decode_neg: pubkey bytes -> extended limbs of -A or None (ed25519 uses
+    RFC 8032 decompression, sr25519 ristretto255 decode)."""
     joined, pub_ok = _normalize_pubs(pubs)
-    with _KS_LOCK:
-        ks = _KS_CACHE.get(joined)
+    with lock:
+        ks = cache.get(joined)
         if ks is not None:
-            _KS_CACHE.move_to_end(joined)
+            cache.move_to_end(joined)
             return ks, ks.key_idx, pub_ok
 
     # build: dedupe, decompress unique keys, build tables on device
@@ -345,17 +350,21 @@ def get_keyset(pubs: list[bytes]) -> tuple[KeySet, np.ndarray, np.ndarray]:
     a_neg = np.broadcast_to(ed.IDENTITY_LIMBS, (len(uniq), 4, 20)).copy()
     valid = np.zeros((max(_round_up(len(uniq), KEY_TILE), KEY_TILE),), dtype=bool)
     for j, p in enumerate(uniq):
-        neg = _decompress_neg(p)
+        neg = decode_neg(p)
         if neg is not None:
             a_neg[j] = neg
             valid[j] = True
     tab_ext = _build_comb_tables_tiled(a_neg)
     ks = KeySet(len(uniq), valid, tab_ext, key_idx)
-    with _KS_LOCK:
-        _KS_CACHE[joined] = ks
-        while len(_KS_CACHE) > _KS_MAX:
-            _KS_CACHE.popitem(last=False)
+    with lock:
+        cache[joined] = ks
+        while len(cache) > _KS_MAX:
+            cache.popitem(last=False)
     return ks, key_idx, pub_ok
+
+
+def get_keyset(pubs: list[bytes]) -> tuple[KeySet, np.ndarray, np.ndarray]:
+    return build_keyset(pubs, _KS_CACHE, _KS_LOCK, _decompress_neg)
 
 
 # ---------------------------------------------------------------------------

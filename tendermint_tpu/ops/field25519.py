@@ -173,6 +173,24 @@ def inv(a):
     return mul(nsquare(z_250_0, 5), z11)  # 2^255 - 21
 
 
+def pow_p58(a):
+    """a^((p-5)/8) = a^(2^252 - 3): the exponentiation inside ristretto255 /
+    ed25519 square-root-ratio computations. Same ladder as inv() up to
+    z_250_0, then two squarings and one multiply."""
+    z2 = square(a)
+    z9 = mul(a, nsquare(z2, 2))
+    z11 = mul(z2, z9)
+    z_5_0 = mul(z9, square(z11))
+    z_10_0 = mul(nsquare(z_5_0, 5), z_5_0)
+    z_20_0 = mul(nsquare(z_10_0, 10), z_10_0)
+    z_40_0 = mul(nsquare(z_20_0, 20), z_20_0)
+    z_50_0 = mul(nsquare(z_40_0, 10), z_10_0)
+    z_100_0 = mul(nsquare(z_50_0, 50), z_50_0)
+    z_200_0 = mul(nsquare(z_100_0, 100), z_100_0)
+    z_250_0 = mul(nsquare(z_200_0, 50), z_50_0)
+    return mul(nsquare(z_250_0, 2), a)  # 2^252 - 4, then +1 -> 2^252 - 3
+
+
 def to_canonical(a):
     """Fully reduce NORM limbs to the canonical representative < p.
 
