@@ -56,15 +56,17 @@ class ScalarBatchVerifier(BatchVerifier):
         return len(self._items)
 
 
-def batch_min() -> int:
+def batch_min(default: int = 32) -> int:
     """Batch-size threshold below which the kernel is never launched.
 
     A 1-vote commit (single-validator chains, gossiped singles) must not pay
     kernel dispatch -- and on a cold process must not pay XLA compilation.
-    The scalar python path verifies one sig in ~1-3 ms; the crossover vs a
-    warm kernel launch sits in the tens of signatures."""
+    The crossover depends on the SCALAR path's speed, so each verifier
+    passes its own default: ed25519's scalar path is ~1-3 ms/sig (crossover
+    in the tens of sigs), sr25519's is pure Python at ~18 ms/sig (crossover
+    ~8). TM_TPU_BATCH_MIN overrides both."""
     v = os.environ.get("TM_TPU_BATCH_MIN")
-    return int(v) if v else 32
+    return int(v) if v else default
 
 
 class _KernelBatchVerifier(BatchVerifier):
@@ -74,6 +76,7 @@ class _KernelBatchVerifier(BatchVerifier):
 
     _scalar_module: str
     _ops_module: str
+    _batch_min_default: int = 32
 
     def __init__(self) -> None:
         self._items: list[tuple[bytes, bytes, bytes]] = []
@@ -81,27 +84,41 @@ class _KernelBatchVerifier(BatchVerifier):
     def add(self, pub_key: keys.PubKey, msg: bytes, sig: bytes) -> None:
         self._items.append((pub_key.bytes(), msg, sig))
 
-    def verify(self) -> tuple[bool, list[bool]]:
+    def dispatch(self):
+        """Issue host prep + device dispatch without fetching. Returns
+        (device_out_or_None, resolve) where resolve(fetched) -> (all_ok,
+        bitmap); fetch device_out with jax.device_get. Small batches verify
+        scalar immediately (device_out None)."""
         import importlib
 
         items, self._items = self._items, []
-        if len(items) < batch_min():
+        if len(items) < batch_min(self._batch_min_default):
             scalar = importlib.import_module(self._scalar_module)
             out = [scalar.verify(p, m, s) for (p, m, s) in items]
-            return all(out), out
+            return None, lambda _: (all(out), out)
         import time as _t
 
         from tendermint_tpu.utils import metrics as tmmetrics
 
         ops = importlib.import_module(self._ops_module)
         started = _t.monotonic()
-        bitmap = ops.verify_batch(items)
-        out = [bool(b) for b in bitmap]
-        if tmmetrics.GLOBAL_NODE_METRICS is not None:
-            m = tmmetrics.GLOBAL_NODE_METRICS
-            m.batch_verify_seconds.observe(_t.monotonic() - started)
-            m.batch_verify_sigs.add(len(items))
-        return all(out), out
+        dev, finish = ops.dispatch_batch(items)
+
+        def resolve(fetched):
+            out = [bool(b) for b in finish(fetched)]
+            if tmmetrics.GLOBAL_NODE_METRICS is not None:
+                m = tmmetrics.GLOBAL_NODE_METRICS
+                m.batch_verify_seconds.observe(_t.monotonic() - started)
+                m.batch_verify_sigs.add(len(items))
+            return all(out), out
+
+        return dev, resolve
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        import jax
+
+        dev, resolve = self.dispatch()
+        return resolve(jax.device_get(dev) if dev is not None else None)
 
     def __len__(self) -> int:
         return len(self._items)
@@ -121,6 +138,9 @@ class Sr25519BatchVerifier(_KernelBatchVerifier):
 
     _scalar_module = "tendermint_tpu.crypto.sr25519"
     _ops_module = "tendermint_tpu.ops.sr25519_batch"
+    # Pure-Python scalar fallback costs ~18 ms/sig; the kernel pays off
+    # almost immediately.
+    _batch_min_default = 8
 
 
 class MixedBatchVerifier(BatchVerifier):
@@ -142,7 +162,24 @@ class MixedBatchVerifier(BatchVerifier):
         sub.add(pub_key, msg, sig)
 
     def verify(self) -> tuple[bool, list[bool]]:
-        results = {kt: sub.verify()[1] for kt, sub in self._subs.items()}
+        # Dispatch every key type's kernel first, then fetch ALL results in
+        # one device_get: the tunnel readback is latency-bound, so a mixed
+        # ed25519+sr25519 commit pays one fetch floor instead of two.
+        import jax
+
+        pairs = []
+        for kt, sub in self._subs.items():
+            if hasattr(sub, "dispatch"):
+                pairs.append((kt,) + sub.dispatch())
+            else:
+                res = sub.verify()
+                pairs.append((kt, None, lambda _fetched, _res=res: _res))
+        devs = [d for (_, d, _) in pairs if d is not None]
+        fetched = iter(jax.device_get(devs) if devs else [])
+        results = {}
+        for kt, d, resolve in pairs:
+            results[kt] = (resolve(next(fetched)) if d is not None
+                           else resolve(None))[1]
         out = [results[kt][i] for (kt, i) in self._order]
         self._order = []
         self._subs = {}

@@ -125,7 +125,9 @@ def _verify_kernel(tab, h_win, s_win, r_y, r_sign, valid, axis_name=None):
 
     acc0 = ed.identity((n,))
     if axis_name is not None:
-        acc0 = jax.lax.pvary(acc0, axis_name)
+        # Mark the loop carry device-varying under shard_map (pvary was
+        # deprecated in favour of pcast in jax 0.9).
+        acc0 = jax.lax.pcast(acc0, axis_name, to="varying")
     acc = jax.lax.fori_loop(0, 64, body, acc0)
 
     y, sign = ed.compress_canonical(acc)
@@ -263,7 +265,8 @@ class KeySet:
     built lazily. `key_idx` maps item slot -> table row for the exact pubkey
     sequence this KeySet was built from."""
 
-    __slots__ = ("n_keys", "valid", "tab_ext", "key_idx", "_gathered", "_niels")
+    __slots__ = ("n_keys", "valid", "tab_ext", "key_idx", "_gathered",
+                 "_niels", "replicated")
 
     def __init__(self, n_keys, valid, tab_ext, key_idx):
         self.n_keys = n_keys
@@ -272,6 +275,9 @@ class KeySet:
         self.key_idx = key_idx
         self._gathered: OrderedDict = OrderedDict()
         self._niels = None
+        # (mesh-devices key, mesh-replicated tab_ext) set by parallel/
+        # batch_shard.replicated_tables on the multi-device path.
+        self.replicated = None
 
     def niels_rows(self):
         """(Kb, 960) niels-form comb tables, built on device once per set."""
@@ -386,13 +392,16 @@ def _r_to_limbs(r32: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return limbs.astype(np.int32), sign
 
 
-def prepare_scalars(items, pub_ok: np.ndarray, windows: bool = True):
+def prepare_scalars(items, pub_ok: np.ndarray, windows: bool = True,
+                    reduce: bool = True):
     """Vectorized per-signature prep: scalars, R bytes, validity.
 
     items: [(pub, msg, sig)]; pub_ok from get_keyset. Returns dict of numpy
     arrays sized to len(items) (unpadded). With windows=False (the Pallas
     path) the comb windows are left to the device and only raw h32/s32
-    scalars are produced -- 40% less H2D payload."""
+    scalars are produced -- 40% less H2D payload. With reduce=False the
+    mod-L reduction is ALSO left to the device: the dict carries the raw
+    (N, 64) SHA-512 digests as "h64" and no "h32"."""
     n = len(items)
     sig_ok = np.fromiter(
         (len(it[2]) == ref.SIGNATURE_SIZE for it in items), dtype=bool, count=n
@@ -415,9 +424,13 @@ def prepare_scalars(items, pub_ok: np.ndarray, windows: bool = True):
     s_lt = sc.lt_l(s32)
     digests = chash.sha512_rab(r32, np.ascontiguousarray(pubs_arr),
                                [it[1] for it in items])
-    h32 = sc.reduce_mod_l(digests)
     valid = sig_ok & s_lt & pub_ok
-    out = dict(h32=h32, s32=s32, r32=r32, valid=valid)
+    out = dict(s32=s32, r32=r32, valid=valid)
+    if not reduce:
+        out["h64"] = digests
+        return out
+    h32 = sc.reduce_mod_l(digests)
+    out["h32"] = h32
     if windows:
         out["h_win"] = sc.comb_windows(h32)
         out["s_win"] = sc.comb_windows(s32)
@@ -471,25 +484,43 @@ def _use_pallas() -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
-def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
-    """Batched verify of [(pub, msg, sig)]; returns (len(items),) bool.
+def dispatch_batch(items: list[tuple[bytes, bytes, bytes]]):
+    """Async batched verify of [(pub, msg, sig)]: all host prep + device
+    dispatches are issued, nothing is fetched. Returns (device_out, finish)
+    where `finish(jax.device_get(device_out))` -> (len(items),) bool. Lets
+    callers (MixedBatchVerifier) overlap the fetch latency of several
+    kernels in ONE device_get -- the tunnel round trip is latency-bound, so
+    two sequential fetches cost two floors, one batched fetch costs one.
 
-    Dispatches to the fused Pallas kernel on TPU (ops/ed25519_pallas); the
-    pure-jnp path remains as the CPU / fallback implementation."""
+    Routes to the fused Pallas kernel on TPU (ops/ed25519_pallas), the
+    shard_map multi-device path when a mesh is present, or the pure-jnp
+    CPU fallback."""
     if not items:
-        return np.zeros((0,), dtype=bool)
+        return None, lambda _: np.zeros((0,), dtype=bool)
     n = len(items)
     ks, key_idx, pub_ok = get_keyset([it[0] for it in items])
     # Non-decompressable keys get an identity comb table; they must be
     # rejected here, exactly as the scalar path's _decompress(pub) is None.
     pub_ok = pub_ok & ks.valid[key_idx]
+    ndev = len(jax.devices())
+    if (ndev > 1 and n >= ndev * MIN_BUCKET
+            and os.environ.get("TM_TPU_DISABLE_SHARD") != "1"):
+        # Multi-chip: shard the signature axis over the device mesh
+        # (BASELINE.json north_star: validator sets sharded across TPU
+        # cores, pass/fail bitmap all-reduced). Batches smaller than one
+        # MIN_BUCKET per device gain nothing from fan-out and stay on the
+        # single-device path.
+        from tendermint_tpu.parallel import batch_shard
+
+        dev = batch_shard.dispatch_batch_sharded(ks, key_idx, items, pub_ok)
+        return dev, lambda v: np.asarray(v)[:n].astype(bool)
     if _use_pallas():
         # Prep is done chunk-by-chunk inside the pipelined path so device
         # compute overlaps host prep of the next chunk.
         from tendermint_tpu.ops import ed25519_pallas
 
-        ok = ed25519_pallas.verify_items_pipelined(ks, key_idx, items, pub_ok)
-        return np.asarray(ok)[:n].astype(bool)
+        dev = ed25519_pallas.dispatch_items_pipelined(ks, key_idx, items, pub_ok)
+        return dev, lambda v: np.asarray(v)[0, :n].astype(bool)
     s = prepare_scalars(items, pub_ok, windows=True)
 
     # Fixed-tile chunking: every batch runs through the one JNP_TILE-shaped
@@ -505,4 +536,10 @@ def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
             k: jnp.asarray(v[off : off + JNP_TILE]) for k, v in padded.items()
         }))
     ok = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
-    return np.asarray(ok)[:n]
+    return ok, lambda v: np.asarray(v)[:n].astype(bool)
+
+
+def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
+    """Batched verify of [(pub, msg, sig)]; returns (len(items),) bool."""
+    dev, finish = dispatch_batch(items)
+    return finish(jax.device_get(dev) if dev is not None else None)

@@ -35,6 +35,7 @@ from jax.experimental.pallas import tpu as pltpu
 from tendermint_tpu.ops import ed25519_batch as edb
 from tendermint_tpu.ops import edwards25519 as ed
 from tendermint_tpu.ops import field25519 as fe
+from tendermint_tpu.ops import scalar25519 as sc_mod
 
 import os
 
@@ -377,6 +378,83 @@ def verify_kernel_pallas(tab, h_win, s_win, r32, valid):
     return _pallas_verify(tab, hw, sw, r_y, r_sv)
 
 
+# --- device-side mod-L reduction (radix-2^12 int32 limbs) -------------------
+#
+# Mirrors scalar25519.reduce_mod_l exactly (differential-tested) but runs as
+# XLA int32 ops on device, so the host uploads the raw 64-byte SHA-512
+# digests and pays no per-signature reduction work. Radix 2^12 because
+# 2^252 = 2^(12*21) is an exact limb boundary (the fold identity is
+# 2^252 === -DELTA mod L) and 12x12-bit products convolved over DELTA's 11
+# limbs stay < 2^31 in int32.
+
+_L_RADIX = 12
+_L_NLIMB = 43  # 43 * 12 = 516 >= 512 bits
+_DELTA12 = np.array(
+    [(sc_mod.DELTA >> (_L_RADIX * i)) & 0xFFF for i in range(11)], dtype=np.int32)
+assert sum(int(d) << (_L_RADIX * i) for i, d in enumerate(_DELTA12)) == sc_mod.DELTA
+
+
+def _digest_to_limbs12(d64):
+    """(64, T) uint8 digest columns -> (43, T) int32 radix-2^12 limbs."""
+    b = d64.astype(jnp.int32)
+    limbs = []
+    for j in range(_L_NLIMB):
+        k, s = divmod(_L_RADIX * j, 8)
+        v = b[k] >> s
+        if k + 1 < 64:
+            v = v | (b[k + 1] << (8 - s))
+        if s + _L_RADIX > 16 and k + 2 < 64:
+            v = v | (b[k + 2] << (16 - s))
+        limbs.append(v & 0xFFF)
+    return jnp.stack(limbs)
+
+
+def _carry_signed12(x, top: int):
+    """Sequential signed floor-carry over rows 0..top-1; row top-1 absorbs
+    the (possibly negative) residue (mirrors scalar25519._carry_signed_t)."""
+    rows = []
+    carry = jnp.zeros_like(x[0])
+    for k in range(top):
+        t = x[k] + carry
+        carry = t >> _L_RADIX  # arithmetic shift = floor division
+        rows.append(t - (carry << _L_RADIX))
+    rows[top - 1] = rows[top - 1] + (carry << _L_RADIX)
+    return jnp.stack(rows + [jnp.zeros_like(x[0])] * (x.shape[0] - top))
+
+
+def _reduce_mod_l_device(d64):
+    """(64, T) uint8 LE 512-bit digests -> (22, T) int32 canonical radix-2^12
+    limbs of the value mod L. Same 4-fold walk as the host reduce_mod_l
+    (v = hi*2^252 + lo -> lo - DELTA*hi, shrinking ~127 bits per fold); each
+    fold's hi covers every limb the previous fold's top residual can reach."""
+    x = _digest_to_limbs12(d64)
+    delta = [int(v) for v in _DELTA12]
+    for nhi, top in ((22, 34), (13, 23), (2, 22), (1, 22)):
+        hi = x[21:21 + nhi]
+        x = jnp.concatenate(
+            [x[:21], jnp.zeros_like(hi), x[21 + nhi:]], axis=0)
+        # x -= conv(DELTA12, hi): 11 shifted row-block subtractions.
+        for i in range(11):
+            x = jnp.concatenate(
+                [x[:i], x[i:i + nhi] - delta[i] * hi, x[i + nhi:]], axis=0)
+        x = _carry_signed12(x, top)
+    return x[:22]
+
+
+def _windows_from_limbs12(limbs):
+    """(22, T) canonical radix-2^12 limbs -> (64, T) int32 comb windows in
+    processing order (mirrors scalar25519.comb_windows bit-for-bit)."""
+    def bit(i):
+        return (limbs[i // _L_RADIX] >> (i % _L_RADIX)) & 1
+
+    rows = []
+    for idx in range(64):
+        j = 63 - idx
+        w = bit(j) | (bit(64 + j) << 1) | (bit(128 + j) << 2) | (bit(192 + j) << 3)
+        rows.append(w)
+    return jnp.stack(rows)
+
+
 def _windows_device(s32):
     """(32, T) uint8 LE scalars -> (64, T) int32 comb windows in processing
     order (mirrors scalar25519.comb_windows exactly: w_j = b_j + 2 b_{64+j}
@@ -397,10 +475,12 @@ def _windows_device(s32):
 
 
 @jax.jit
-def _verify_chunk(tab, h32, s32, r32, valid):
+def _verify_chunk(tab, h64, s32, r32, valid):
     """One fixed-shape chunk: tab (960, CHUNK) int32 device-resident niels
-    tables; h32/s32/r32 (32, CHUNK) uint8; valid (1, CHUNK) uint8."""
-    hw = _windows_device(h32)
+    tables; h64 (64, CHUNK) uint8 RAW SHA-512 digests (mod-L reduction and
+    comb windows both run on device); s32/r32 (32, CHUNK) uint8;
+    valid (1, CHUNK) uint8."""
+    hw = _windows_from_limbs12(_reduce_mod_l_device(h64))
     sw = _windows_device(s32)
     r_y, sign = _r_limbs_device(r32)
     r_sv = jnp.concatenate([sign, valid.astype(jnp.int32)], axis=0)
@@ -419,18 +499,20 @@ if CHUNK % TILE != 0 or CHUNK <= 0:
         f"TM_TPU_PALLAS_CHUNK must be a positive multiple of TILE={TILE}, got {CHUNK}")
 
 
-def verify_items_pipelined(ks, key_idx: np.ndarray, items, pub_ok) -> np.ndarray:
-    """Chunk-pipelined verify: host prep of chunk i+1 overlaps device
-    compute of chunk i (dispatches are async; the single blocking readback
-    is at the end). On the 1-core host this hides min(prep, device) per
-    chunk versus the prep-everything-then-dispatch path."""
+def dispatch_items_pipelined(ks, key_idx: np.ndarray, items, pub_ok):
+    """Chunk-pipelined dispatch: host prep of chunk i+1 overlaps device
+    compute of chunk i (dispatches are async). Returns the (1, Npad) int32
+    device array WITHOUT fetching -- callers batch the readback. On the
+    1-core host this hides min(prep, device) per chunk versus the
+    prep-everything-then-dispatch path."""
     from tendermint_tpu.ops import ed25519_batch as edb
 
     n = len(items)
     outs = []
     for off in range(0, n, CHUNK):
         sl = slice(off, min(off + CHUNK, n))
-        s = edb.prepare_scalars(items[sl], pub_ok[sl], windows=False)
+        s = edb.prepare_scalars(items[sl], pub_ok[sl], windows=False,
+                                reduce=False)
         cn = sl.stop - sl.start
         idx = np.zeros((CHUNK,), dtype=np.int32)
         idx[:cn] = key_idx[sl]
@@ -443,10 +525,9 @@ def verify_items_pipelined(ks, key_idx: np.ndarray, items, pub_ok) -> np.ndarray
         tab = ks.gathered_lane(idx)
         outs.append(_verify_chunk(
             tab,
-            jnp.asarray(pad_cols(s["h32"], 32)),
+            jnp.asarray(pad_cols(s["h64"], 64)),
             jnp.asarray(pad_cols(s["s32"], 32)),
             jnp.asarray(pad_cols(s["r32"], 32)),
             jnp.asarray(pad_cols(s["valid"].astype(np.uint8), 1)),
         ))
-    ok = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
-    return np.asarray(ok)[0, :n].astype(bool)
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)

@@ -101,14 +101,22 @@ def reduce_mod_l(values_le: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(words.T).view(np.uint8)
 
 
-def lt_l(s_le: np.ndarray) -> np.ndarray:
-    """(N, 32) uint8 little-endian -> (N,) bool: s < L."""
+def lt_bound(s_le: np.ndarray, bound_be: np.ndarray) -> np.ndarray:
+    """(N, 32) uint8 little-endian values < bound? -> (N,) bool.
+
+    bound_be: the bound's 32 big-endian bytes as int16. Vectorized
+    big-endian byte compare: the first differing byte decides."""
     s_be = s_le[:, ::-1].astype(np.int16)
-    diff = s_be - _L_BYTES_BE  # big-endian byte-wise difference
+    diff = s_be - bound_be
     nz = diff != 0
     first = np.argmax(nz, axis=1)
     first_diff = np.take_along_axis(diff, first[:, None], axis=1)[:, 0]
     return np.where(nz.any(axis=1), first_diff < 0, False)
+
+
+def lt_l(s_le: np.ndarray) -> np.ndarray:
+    """(N, 32) uint8 little-endian -> (N,) bool: s < L."""
+    return lt_bound(s_le, _L_BYTES_BE)
 
 
 def comb_windows(scalar_le: np.ndarray) -> np.ndarray:

@@ -239,19 +239,15 @@ def _bytes_to_limbs(b32: np.ndarray) -> np.ndarray:
 
 def _lt_p(s_le: np.ndarray) -> np.ndarray:
     """(N, 32) uint8 LE -> (N,) bool: value < p (canonical field encoding)."""
-    s_be = s_le[:, ::-1].astype(np.int16)
-    diff = s_be - _P_BYTES_BE
-    nz = diff != 0
-    first = np.argmax(nz, axis=1)
-    first_diff = np.take_along_axis(diff, first[:, None], axis=1)[:, 0]
-    return np.where(nz.any(axis=1), first_diff < 0, False)
+    return sc.lt_bound(s_le, _P_BYTES_BE)
 
 
-def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
-    """Batched verify of [(pub, msg, sig)]; returns (len(items),) bool,
-    byte-identical accept/reject with crypto/sr25519.verify."""
+def dispatch_batch(items: list[tuple[bytes, bytes, bytes]]):
+    """Async batched verify (same contract as ed25519_batch.dispatch_batch):
+    returns (device_out, finish) with nothing fetched, so mixed-key commits
+    overlap the ed25519 and sr25519 readbacks in one device_get."""
     if not items:
-        return np.zeros((0,), dtype=bool)
+        return None, lambda _: np.zeros((0,), dtype=bool)
     n = len(items)
     ks, key_idx, pub_ok = get_keyset([it[0] for it in items])
     pub_ok = pub_ok & ks.valid[key_idx]
@@ -303,4 +299,11 @@ def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
             jnp.asarray(va[off:off + tile]),
         ))
     ok = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
-    return np.asarray(ok)[:n]
+    return ok, lambda v: np.asarray(v)[:n]
+
+
+def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
+    """Batched verify of [(pub, msg, sig)]; returns (len(items),) bool,
+    byte-identical accept/reject with crypto/sr25519.verify."""
+    dev, finish = dispatch_batch(items)
+    return finish(jax.device_get(dev) if dev is not None else None)

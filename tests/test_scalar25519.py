@@ -97,3 +97,33 @@ def test_chash_differential_vs_hashlib():
     for i, m in enumerate(msgs):
         want = hashlib.sha512(bytes(r32[i]) + bytes(a32[i]) + m).digest()
         assert bytes(got[i]) == want, len(m)
+
+
+def test_device_mod_l_reduction_matches_host():
+    """The device-side radix-2^12 mod-L reducer + window extractor
+    (ops/ed25519_pallas) must be bit-identical to the host numpy path
+    (scalar25519.reduce_mod_l / comb_windows) -- it feeds the comb kernel."""
+    import jax.numpy as jnp
+
+    from tendermint_tpu.ops import ed25519_pallas as edp
+    from tendermint_tpu.ops import scalar25519 as sc
+
+    rng = np.random.default_rng(3)
+    cases = [rng.integers(0, 256, size=(64,), dtype=np.uint8) for _ in range(64)]
+    L = sc.L
+    for v in [0, 1, L - 1, L, L + 1, 2**252, 2**252 - 1,
+              (2**512 - 1) // L * L, (2**512 - 1) // L * L - 1, 2**512 - 1,
+              L * 2**259, L * 2**259 + 5]:
+        cases.append(np.frombuffer(
+            int(v % 2**512).to_bytes(64, "little"), dtype=np.uint8).copy())
+    arr = np.stack(cases)
+    host = sc.reduce_mod_l(arr)
+    dev = np.asarray(edp._reduce_mod_l_device(jnp.asarray(arr.T)))
+    for i in range(len(cases)):
+        want = int.from_bytes(host[i].tobytes(), "little")
+        got = sum(int(dev[j, i]) << (12 * j) for j in range(22))
+        assert got == want, i
+        assert all(0 <= dev[j, i] < 4096 for j in range(22)), i
+    hw_host = sc.comb_windows(host)
+    hw_dev = np.asarray(edp._windows_from_limbs12(jnp.asarray(dev)))
+    assert (hw_host == hw_dev.T).all()
