@@ -73,24 +73,22 @@ def _read_msg(rfile) -> bytes | None:
 
 
 def _pubkey_marshal(pub: keys.PubKey) -> bytes:
-    # crypto proto PublicKey oneof: ed25519=1, secp256k1=2 (reference:
-    # proto/tendermint/crypto/keys.proto). Any other key type (sr25519) is
-    # NOT representable -- defaulting to field 1 would make the node
-    # unmarshal it as ed25519: wrong address, every verify fails silently.
-    fieldnum = {"ed25519": 1, "secp256k1": 2}.get(pub.type)
-    if fieldnum is None:
-        raise ValueError(
-            f"key type {pub.type!r} not representable in the PublicKey oneof")
-    return proto.Writer().bytes(fieldnum, pub.bytes()).out()
+    # The types/validator.py PublicKey oneof (ed25519=1, secp256k1=2, plus
+    # the documented sr25519=3 extension). An unknown key type raises --
+    # defaulting to field 1 would make the node unmarshal it as ed25519:
+    # wrong address, every verify fails silently.
+    from tendermint_tpu.types.validator import pubkey_proto_bytes
+
+    return pubkey_proto_bytes(pub)
 
 
 def _pubkey_unmarshal(buf: bytes) -> keys.PubKey:
-    f = proto.fields(buf)
-    if 1 in f:
-        return keys.pubkey_from_type_bytes("ed25519", f[1][-1])
-    if 2 in f:
-        return keys.pubkey_from_type_bytes("secp256k1", f[2][-1])
-    raise ValueError("empty remote-signer pubkey")
+    from tendermint_tpu.types.validator import pubkey_from_proto_bytes
+
+    try:
+        return pubkey_from_proto_bytes(buf)
+    except ValueError:
+        raise ValueError("empty remote-signer pubkey") from None
 
 
 def _error_marshal(e: RemoteSignerError) -> bytes:

@@ -23,8 +23,14 @@ def clip_int64(v: int) -> int:
 def pubkey_proto_bytes(pub: keys.PubKey) -> bytes:
     """tendermint.crypto.PublicKey oneof marshal (reference:
     crypto/encoding/codec.go PubKeyToProto; keys.proto fields: ed25519=1,
-    secp256k1=2)."""
-    field_num = {"ed25519": 1, "secp256k1": 2}.get(pub.type)
+    secp256k1=2).
+
+    EXTENSION: sr25519 = 3. The v0.34 reference ships an sr25519 key type
+    but cannot proto-encode it (codec.go:35-38 errors), so sr25519
+    validators can't exist in a reference validator set at all; field 3 is
+    the convention forks that do support it use. Wire compatibility for
+    ed25519/secp256k1 chains is unaffected."""
+    field_num = {"ed25519": 1, "secp256k1": 2, "sr25519": 3}.get(pub.type)
     if field_num is None:
         raise ValueError(f"key type {pub.type} not representable in PublicKey proto")
     return proto.Writer().bytes(field_num, pub.bytes()).out()
@@ -36,6 +42,8 @@ def pubkey_from_proto_bytes(buf: bytes) -> keys.PubKey:
         return keys.pubkey_from_type_bytes("ed25519", f[1][-1])
     if 2 in f:
         return keys.pubkey_from_type_bytes("secp256k1", f[2][-1])
+    if 3 in f:
+        return keys.pubkey_from_type_bytes("sr25519", f[3][-1])
     raise ValueError("empty PublicKey proto")
 
 
