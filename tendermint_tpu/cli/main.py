@@ -93,6 +93,11 @@ def cmd_start(args) -> int:
         cfg.p2p.persistent_peers = args.persistent_peers
 
     node = Node(cfg)
+    mb = os.environ.get("TMTPU_MISBEHAVIOR")
+    if mb:
+        # e2e byzantine node (reference: test/maverick); honest peers must
+        # detect the equivocation and keep committing.
+        node.install_misbehavior(mb)
     node.start()
     print(f"Started node {node.node_key.id()} p2p={node.transport.node_info.listen_addr}")
 

@@ -1,0 +1,80 @@
+"""Deterministic random e2e manifest generator (reference:
+test/e2e/generator/generate.go — the reference rolls random testnet
+topologies from a seed and runs the whole matrix nightly; same idea here
+over the dimensions this runner supports).
+
+Every draw is derived from the seed, so a failing topology is reproducible
+by number: `python -m tendermint_tpu.e2e.generator --seed 42 --count 8`
+writes manifest JSON files; `generate(seed)` returns Manifest objects.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import asdict
+
+from tendermint_tpu.e2e.runner import Manifest, Perturbation
+
+# Dimension tables (reference: generator/generate.go testnetCombinations).
+_VALIDATORS = (2, 3, 4, 5)
+_FASTSYNC = ("v0", "v0", "v1", "v2")  # v0 weighted: the default path
+_PERTURB_ACTIONS = ("kill", "restart", "pause")
+
+
+def generate_one(rng: random.Random, index: int = 0) -> Manifest:
+    n_vals = rng.choice(_VALIDATORS)
+    target = rng.randrange(8, 14)
+    perts = []
+    # Perturb at most floor((n-1)/3) nodes concurrently-ish: the net must
+    # keep > 2/3 honest-and-up power to make progress while one node is
+    # down, so small nets get at most one perturbation.
+    for _ in range(rng.randrange(0, 2 if n_vals < 4 else 3)):
+        perts.append(Perturbation(
+            node=rng.randrange(n_vals),
+            action=rng.choice(_PERTURB_ACTIONS),
+            at_height=rng.randrange(3, max(4, target - 3)),
+            revive_after_s=round(rng.uniform(0.5, 2.0), 1),
+        ))
+    # A byzantine node needs >= 4 validators (1 byzantine < 1/3 of 4);
+    # roll it on a third of the big topologies.
+    byz = -1
+    if n_vals >= 4 and rng.random() < 0.33:
+        byz = rng.randrange(n_vals)
+    return Manifest(
+        validators=n_vals,
+        chain_id=f"gen-{index}",
+        target_height=target,
+        load_txs=rng.randrange(5, 25),
+        perturbations=perts,
+        byzantine_node=byz,
+        fastsync_version=rng.choice(_FASTSYNC),
+        statesync_joiner=n_vals >= 3 and rng.random() < 0.25,
+    )
+
+
+def generate(seed: int, count: int = 8) -> list[Manifest]:
+    rng = random.Random(seed)
+    return [generate_one(rng, i) for i in range(count)]
+
+
+def main(argv=None) -> int:
+    import argparse
+    import os
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--count", type=int, default=8)
+    ap.add_argument("--output", default="generated-manifests")
+    args = ap.parse_args(argv)
+    os.makedirs(args.output, exist_ok=True)
+    for i, m in enumerate(generate(args.seed, args.count)):
+        path = os.path.join(args.output, f"manifest-{args.seed}-{i}.json")
+        with open(path, "w") as f:
+            json.dump(asdict(m), f, indent=1)
+        print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -46,6 +46,16 @@ class Manifest:
     load_txs: int = 10
     starting_port: int = 0  # 0 -> pick a free range
     perturbations: list[Perturbation] = field(default_factory=list)
+    # Node index to run byzantine (reference: maverick nodes in e2e
+    # manifests, pkg/manifest.go Misbehaviors), -1 = none. The byzantine
+    # node equivocates from the given height via TMTPU_MISBEHAVIOR; honest
+    # >2/3 must keep committing and produce DuplicateVoteEvidence.
+    byzantine_node: int = -1
+    misbehavior: str = "double_prevote"
+    # Fast-sync version for all nodes (reference: manifest fast_sync key).
+    fastsync_version: str = "v0"
+    # Add a post-start state-sync joiner node (reference: statesync nodes).
+    statesync_joiner: bool = False
 
     @staticmethod
     def from_file(path: str) -> "Manifest":
@@ -108,6 +118,17 @@ class Runner:
             raise RuntimeError("testnet setup failed")
         # default_config already uses the durable sqlite backend, so
         # kill/restart exercises real recovery; nothing to patch.
+        if self.m.fastsync_version != "v0":
+            from tendermint_tpu.config.config import default_config
+            from tendermint_tpu.config.toml import (
+                load_toml_into, write_config_toml)
+
+            for i in range(self.m.validators):
+                home = os.path.join(self.workdir, f"node{i}")
+                path = os.path.join(home, "config", "config.toml")
+                cfg = load_toml_into(default_config().set_root(home), path)
+                cfg.fastsync.version = self.m.fastsync_version
+                write_config_toml(cfg, path)
 
     def _spawn(self, i: int) -> subprocess.Popen:
         env = {**os.environ, "JAX_PLATFORMS": "cpu",
@@ -116,6 +137,8 @@ class Runner:
                # state-sync in (reference e2e: snapshot_interval manifest key)
                "TMTPU_KVSTORE_SNAPSHOT_INTERVAL":
                    os.environ.get("TMTPU_KVSTORE_SNAPSHOT_INTERVAL", "4")}
+        if i == self.m.byzantine_node:
+            env["TMTPU_MISBEHAVIOR"] = self.m.misbehavior
         log = open(os.path.join(self.workdir, f"node{i}.log"), "ab")
         return subprocess.Popen(
             [sys.executable, "-m", "tendermint_tpu.cli",
@@ -130,11 +153,14 @@ class Runner:
         """Submit load_txs round-robin over the nodes' RPC (reference:
         runner/load.go)."""
         sent = 0
+        attempt = 0
         deadline = time.monotonic() + 60
         while sent < self.m.load_txs and time.monotonic() < deadline:
-            node = sent % self.m.validators
+            node = attempt % self.m.validators
+            attempt += 1
             if node in self._paused or self.procs.get(node) is None:
-                sent += 1
+                if attempt % self.m.validators == 0:
+                    time.sleep(0.05)  # every node skipped: don't spin hot
                 continue
             tx = b"e2e%d=v%d" % (sent, sent)
             try:
@@ -143,6 +169,50 @@ class Runner:
                 sent += 1
             except Exception:  # noqa: BLE001 - node may still be booting
                 time.sleep(0.3)
+
+    def load_report(self, window_s: float = 20.0) -> dict:
+        """Timed load window -> throughput report (reference:
+        test/loadtime/ + the QA tables in docs/qa/v034/README.md; the
+        anchors there: 19.5 blocks/min, ~200-339 tx/s on 200 4-core
+        droplets — this is a 1-core localnet, so the numbers are recorded
+        for trend, not for parity with that hardware).
+
+        Returns {window_s, blocks, blocks_per_min, txs_committed, tx_per_s,
+        first_height, last_height}."""
+        import base64
+
+        start_h = self.max_height()
+        deadline = time.monotonic() + window_s
+        sent = 0
+        attempt = 0  # round-robin cursor: advances even past dead/erroring
+        while time.monotonic() < deadline:  # nodes, so one sick node can't
+            node = attempt % self.m.validators  # pin the whole window
+            attempt += 1
+            if node in self._paused or self.procs.get(node) is None:
+                if attempt % self.m.validators == 0:
+                    time.sleep(0.05)  # every node skipped: don't spin hot
+                continue
+            tx = b"load%d=v%d" % (sent, sent)
+            try:
+                self._rpc(node, "broadcast_tx_sync",
+                          {"tx": base64.b64encode(tx).decode()})
+                sent += 1
+            except Exception:  # noqa: BLE001
+                time.sleep(0.2)
+        end_h = self.max_height()
+        txs = 0
+        for h in range(start_h + 1, end_h + 1):
+            try:
+                b = self._rpc(0, "block", {"height": str(h)})
+                txs += len(b["block"]["data"]["txs"] or [])
+            except Exception:  # noqa: BLE001
+                continue
+        blocks = end_h - start_h
+        return dict(window_s=window_s, blocks=blocks,
+                    blocks_per_min=round(blocks * 60.0 / window_s, 1),
+                    txs_sent=sent, txs_committed=txs,
+                    tx_per_s=round(txs / window_s, 1),
+                    first_height=start_h, last_height=end_h)
 
     def perturb_and_wait(self, timeout_s: float = 180.0) -> None:
         """Run the perturbation schedule while waiting for target_height
@@ -300,14 +370,22 @@ class Runner:
         return doc["result"]
 
 
-def run_manifest(manifest: Manifest, workdir: str) -> None:
-    """All stages end to end (reference: runner/main.go)."""
+def run_manifest(manifest: Manifest, workdir: str,
+                 with_load_report: bool = False) -> dict:
+    """All stages end to end (reference: runner/main.go). Returns a report
+    dict (throughput numbers when with_load_report)."""
     r = Runner(manifest, workdir)
     r.setup()
     r.start()
+    report: dict = {}
     try:
         r.load()
         r.perturb_and_wait()
         r.assert_consistent(max(manifest.target_height - 2, 1))
+        if with_load_report:
+            report = r.load_report()
+        if manifest.statesync_joiner:
+            report["joiner_index"] = r.join_statesync_node()
     finally:
         r.stop()
+    return report

@@ -246,6 +246,32 @@ class Node:
         self.rpc_server = None
         self._tx_notify_thread = None
 
+    def install_misbehavior(self, name: str) -> None:
+        """Maverick mode: make THIS node byzantine (reference:
+        test/maverick/consensus/misbehavior.go, selected per node via the
+        maverick binary's --misbehaviors flag; here via the
+        TMTPU_MISBEHAVIOR env var so an e2e manifest can mark a real
+        PROCESS byzantine).
+
+        Swaps the double-sign-guarded FilePV for an unguarded signer with
+        the SAME key (a byzantine actor ignores its own safety guard) and
+        installs the consensus hook."""
+        from tendermint_tpu.consensus import misbehavior as mb
+        from tendermint_tpu.privval.file_pv import FilePV, MockPV
+
+        if isinstance(self.priv_validator, FilePV):
+            unguarded = MockPV(self.priv_validator.priv_key)
+            self.priv_validator = unguarded
+            self.consensus.priv_validator = unguarded
+            self.consensus.priv_validator_pub_key = unguarded.get_pub_key()
+        hooks = {
+            "double_prevote": lambda: mb.double_prevote(self.switch),
+            "absent_prevote": lambda: mb.absent_prevote,
+        }
+        if name not in hooks:
+            raise ValueError(f"unknown misbehavior {name!r}")
+        self.consensus.misbehaviors["prevote"] = hooks[name]()
+
     # --- lifecycle (reference: node/node.go:941 OnStart) -------------------
 
     def start(self) -> None:

@@ -74,11 +74,41 @@ def _commit_json(c) -> dict:
     }
 
 
+def _evidence_json(ev) -> dict:
+    """reference: types/evidence.go MarshalJSON shapes (subset)."""
+    from tendermint_tpu.types.evidence import (
+        DuplicateVoteEvidence, LightClientAttackEvidence)
+
+    if isinstance(ev, DuplicateVoteEvidence):
+        return {"type": "tendermint/DuplicateVoteEvidence", "value": {
+            "vote_a": {"height": str(ev.vote_a.height),
+                       "round": ev.vote_a.round,
+                       "type": ev.vote_a.type,
+                       "validator_address": _hex(ev.vote_a.validator_address),
+                       "block_id": _block_id_json(ev.vote_a.block_id)},
+            "vote_b": {"height": str(ev.vote_b.height),
+                       "round": ev.vote_b.round,
+                       "type": ev.vote_b.type,
+                       "validator_address": _hex(ev.vote_b.validator_address),
+                       "block_id": _block_id_json(ev.vote_b.block_id)},
+            "total_voting_power": str(ev.total_voting_power),
+            "validator_power": str(ev.validator_power),
+            "timestamp": str(ev.timestamp),
+        }}
+    if isinstance(ev, LightClientAttackEvidence):
+        return {"type": "tendermint/LightClientAttackEvidence", "value": {
+            "common_height": str(ev.common_height),
+            "total_voting_power": str(ev.total_voting_power),
+            "timestamp": str(ev.timestamp),
+        }}
+    return {"type": type(ev).__name__, "value": {}}
+
+
 def _block_json(b) -> dict:
     return {
         "header": _header_json(b.header),
         "data": {"txs": [_b64(t) for t in b.data.txs]},
-        "evidence": {"evidence": []},
+        "evidence": {"evidence": [_evidence_json(e) for e in b.evidence]},
         "last_commit": _commit_json(b.last_commit),
     }
 
