@@ -10,12 +10,19 @@ size-based app hash semantics.
 from __future__ import annotations
 
 import base64
+import hashlib
 import struct
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.store.db import DB, MemDB
 
 VALIDATOR_TX_PREFIX = b"val:"
+
+
+def _ed25519_address(pub_key_bytes: bytes) -> bytes:
+    """The ed25519 validator address rule: SHA-256 truncated to 20 bytes
+    (crypto/ed25519.PubKey.address; this app only registers ed25519 keys)."""
+    return hashlib.sha256(pub_key_bytes).digest()[:20]
 
 
 SNAPSHOT_FORMAT = 1
@@ -31,6 +38,10 @@ class KVStoreApplication(abci.Application):
         self.app_hash = b""
         self.val_updates: list[abci.ValidatorUpdate] = []
         self.validators: dict[bytes, int] = {}  # pubkey bytes -> power
+        # address -> pubkey, for slashing byzantine validators reported by
+        # address in BeginBlock (reference: persistent_kvstore.go
+        # valAddrToPubKeyMap)
+        self.addr_to_pubkey: dict[bytes, bytes] = {}
         # snapshot support (reference: the e2e app, test/e2e/app/app.go;
         # the reference kvstore itself has none)
         self.snapshot_interval = snapshot_interval
@@ -72,6 +83,22 @@ class KVStoreApplication(abci.Application):
 
     def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
         self.val_updates = []
+        # Slash byzantine validators to zero power (reference:
+        # abci/example/kvstore/persistent_kvstore.go:140-170: the persistent
+        # kvstore punishes DUPLICATE_VOTE; light-client attacks carry the
+        # same attributable signatures, so both slash here).
+        for ev in req.byzantine_validators:
+            if ev.type not in (abci.EVIDENCE_TYPE_DUPLICATE_VOTE,
+                               abci.EVIDENCE_TYPE_LIGHT_CLIENT_ATTACK):
+                continue
+            if ev.validator is None:
+                continue
+            pk = self.addr_to_pubkey.get(ev.validator.address)
+            if pk is None:
+                continue
+            vu = abci.ValidatorUpdate("ed25519", pk, 0)
+            self.val_updates.append(vu)
+            self._apply_validator_update(vu)
         return abci.ResponseBeginBlock()
 
     def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
@@ -150,6 +177,7 @@ class KVStoreApplication(abci.Application):
         # install atomically only after a full parse
         self.size, self.height, self.app_hash = size, height, app_hash
         self.validators = validators
+        self.addr_to_pubkey = {_ed25519_address(pk): pk for pk in validators}
         for k, v in pairs:
             self.db.set(k, v)
         self._save_state()
@@ -216,10 +244,13 @@ class KVStoreApplication(abci.Application):
     # --- helpers -----------------------------------------------------------
 
     def _apply_validator_update(self, vu: abci.ValidatorUpdate) -> None:
+        addr = _ed25519_address(vu.pub_key_bytes)
         if vu.power == 0:
             self.validators.pop(vu.pub_key_bytes, None)
+            self.addr_to_pubkey.pop(addr, None)
         else:
             self.validators[vu.pub_key_bytes] = vu.power
+            self.addr_to_pubkey[addr] = vu.pub_key_bytes
 
     @staticmethod
     def _parse_val_tx(tx: bytes):

@@ -187,6 +187,36 @@ class EvidencePool:
         if ours is not None and ours.block_id.hash == sh.header.hash():
             raise EvidenceError("conflicting block is the same as our block; not an attack")
 
+        # metadata cross-checks (reference: evidence/verify.go:239-280):
+        # the byzantine validators, total power, and timestamp the evidence
+        # carries must equal what this node derives from its own state.
+        if ev.total_voting_power != common_vals.total_voting_power():
+            raise EvidenceError(
+                f"evidence total power {ev.total_voting_power} != "
+                f"{common_vals.total_voting_power()}")
+        common_meta = self.block_store.load_block_meta(ev.common_height)
+        if common_meta is not None and ev.timestamp != common_meta.header.time:
+            raise EvidenceError("evidence timestamp != common block time")
+        trusted = self.block_store.load_block(sh.header.height)
+        trusted_commit = (self.block_store.load_block_commit(sh.header.height)
+                          or self.block_store.load_seen_commit(sh.header.height))
+        if trusted is not None and trusted_commit is not None:
+            from tendermint_tpu.types.light_block import SignedHeader
+
+            trusted_sh = SignedHeader(trusted.header, trusted_commit)
+            derived = ev.get_byzantine_validators(common_vals, trusted_sh)
+            carried = ev.byzantine_validators
+            if len(derived) != len(carried):
+                raise EvidenceError(
+                    f"expected {len(derived)} byzantine validators, "
+                    f"evidence names {len(carried)}")
+            for d, c in zip(derived, carried):
+                if d.address != c.address or d.voting_power != c.voting_power:
+                    raise EvidenceError(
+                        "byzantine validator mismatch: "
+                        f"{d.address.hex()}/{d.voting_power} != "
+                        f"{c.address.hex()}/{c.voting_power}")
+
     # --- lifecycle hooks ---------------------------------------------------
 
     def check_evidence(self, state, evidence_list: list) -> None:

@@ -139,11 +139,16 @@ def _handle_conflicting_headers(client, conflict: ErrConflictingHeaders,
         # Faulty/lying witness that can't back its header: caller drops it
         # and verification continues (reference: detector.go:105-110).
         return False
+
     # Evidence against whichever chain diverges from the common ancestor:
     # report both directions; honest full nodes discard the invalid one
-    # (reference: light/detector.go:135-176 gatherEvidence).
-    ev_against_witness = make_attack_evidence(common, witness_block)
-    ev_against_primary = make_attack_evidence(common, primary_block)
+    # (reference: light/detector.go:135-176 gatherEvidence). Evidence
+    # against one chain names the OTHER chain's block as the trusted
+    # counterpart for byzantine-validator extraction.
+    ev_against_witness = make_attack_evidence(
+        common, witness_block, primary_block.signed_header)
+    ev_against_primary = make_attack_evidence(
+        common, primary_block, witness_block.signed_header)
     for ev, target in ((ev_against_witness, client.primary),
                        (ev_against_primary, witness)):
         if ev is None:
@@ -155,22 +160,28 @@ def _handle_conflicting_headers(client, conflict: ErrConflictingHeaders,
     return True
 
 
-def make_attack_evidence(common: LightBlock,
-                         conflicted: LightBlock) -> LightClientAttackEvidence | None:
+def make_attack_evidence(
+    common: LightBlock, conflicted: LightBlock, trusted_sh=None,
+) -> LightClientAttackEvidence | None:
     """reference: light/detector.go:271 newLightClientAttackEvidence.
 
-    byzantine validator extraction happens server-side in the evidence pool
-    (evidence/verify.go GetByzantineValidators); the light client ships the
-    conflicting block + common height.
-    """
+    When the trusted counterpart header (the OTHER chain's block at the
+    conflicting height) is supplied, the provably-faulty validators are
+    extracted up front (reference fills ByzantineValidators the same way);
+    the receiving pool re-derives and cross-checks them
+    (evidence/verify.go:239-267)."""
     if conflicted is None:
         return None
-    return LightClientAttackEvidence(
+    ev = LightClientAttackEvidence(
         conflicting_block=conflicted,
         common_height=common.height,
         total_voting_power=common.validator_set.total_voting_power(),
         timestamp=common.signed_header.header.time,
     )
+    if trusted_sh is not None:
+        ev.byzantine_validators = ev.get_byzantine_validators(
+            common.validator_set, trusted_sh)
+    return ev
 
 
 __all__ = [

@@ -134,6 +134,48 @@ class LightClientAttackEvidence:
         if self.common_height <= 0:
             raise EvidenceError("negative or zero common height")
 
+    def conflicting_header_is_invalid(self, trusted_header) -> bool:
+        """Lunatic test: a correctly-derived conflicting header would share
+        every state-derived field with our trusted header at that height
+        (reference: types/evidence.go:219 ConflictingHeaderIsInvalid)."""
+        ch = self.conflicting_block.signed_header.header
+        return (trusted_header.validators_hash != ch.validators_hash
+                or trusted_header.next_validators_hash != ch.next_validators_hash
+                or trusted_header.consensus_hash != ch.consensus_hash
+                or trusted_header.app_hash != ch.app_hash
+                or trusted_header.last_results_hash != ch.last_results_hash)
+
+    def get_byzantine_validators(self, common_vals, trusted_sh) -> list:
+        """The validators provably at fault for this attack (reference:
+        types/evidence.go:233 GetByzantineValidators).
+
+        Lunatic (invalid header): members of the COMMON set that signed the
+        fabricated block. Equivocation (same round): validators that signed
+        both commits. Amnesia (different round, derived header): not
+        attributable from the two commits alone -> empty."""
+        ch = self.conflicting_block.signed_header
+        out = []
+        if self.conflicting_header_is_invalid(trusted_sh.header):
+            for sig in ch.commit.signatures:
+                if not sig.for_block():
+                    continue
+                _, val = common_vals.get_by_address(sig.validator_address)
+                if val is not None:
+                    out.append(val)
+        elif trusted_sh.commit.round == ch.commit.round:
+            for sig_a, sig_b in zip(ch.commit.signatures,
+                                    trusted_sh.commit.signatures):
+                if sig_a.absent() or sig_b.absent():
+                    continue
+                _, val = self.conflicting_block.validator_set.get_by_address(
+                    sig_a.validator_address)
+                if val is not None:
+                    out.append(val)
+        else:
+            return []
+        out.sort(key=lambda v: (-v.voting_power, v.address))
+        return out
+
     def __str__(self) -> str:
         return (
             f"LightClientAttackEvidence{{CommonHeight: {self.common_height}, "

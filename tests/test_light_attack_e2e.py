@@ -1,0 +1,183 @@
+"""Lunatic light-client attack end to end: a lying primary is caught by the
+light client's witness cross-check, the evidence it ships names the
+byzantine validators, a full node's evidence pool re-derives and
+cross-checks them, the block executor hands them to ABCI, and the kvstore
+app slashes them to zero power (reference: light/detector.go:120-200,
+evidence/verify.go:113-160, types/evidence.go:233 GetByzantineValidators,
+abci/example/kvstore/persistent_kvstore.go:140-170)."""
+
+import dataclasses
+
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.evidence.pool import EvidencePool
+from tendermint_tpu.light.client import SKIPPING, Client, TrustOptions
+from tendermint_tpu.light.detector import ErrConflictingHeaders
+from tendermint_tpu.light.provider import MockProvider
+from tendermint_tpu.light.store import DBStore
+from tendermint_tpu.mempool.mempool import Mempool
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import make_genesis_state
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.store.db import MemDB
+from tendermint_tpu.types.block import Commit, CommitSig
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.light_block import LightBlock, SignedHeader
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import (
+    BLOCK_ID_FLAG_COMMIT,
+    PRECOMMIT_TYPE,
+    Vote,
+)
+
+CHAIN_ID = "attack-chain"
+
+
+def _commit_for(state, block, privs, signers=None, round_=0):
+    bid = BlockID(hash=block.hash(),
+                  part_set_header=PartSet.from_data(block.marshal()).header())
+    by_addr = {p.pub_key().address(): p for p in privs}
+    sigs = []
+    vals = signers if signers is not None else state.validators
+    for i, val in enumerate(vals.validators):
+        priv = by_addr[val.address]
+        v = Vote(type=PRECOMMIT_TYPE, height=block.header.height, round=round_,
+                 block_id=bid, timestamp=block.header.time.add_ns(1_000_000),
+                 validator_address=val.address, validator_index=i)
+        v.signature = priv.sign(v.sign_bytes(CHAIN_ID))
+        sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, val.address,
+                              v.timestamp, v.signature))
+    return bid, Commit(height=block.header.height, round=round_, block_id=bid,
+                       signatures=sigs)
+
+
+def test_lunatic_attack_detector_to_slash():
+    # --- 1. the honest full node: real stores, real executed chain --------
+    privs = [ed25519.gen_priv_key(bytes([70 + i]) * 32) for i in range(4)]
+    gd = GenesisDoc(
+        chain_id=CHAIN_ID, genesis_time=Time(1_700_000_000, 0),
+        validators=[GenesisValidator(b"", p.pub_key(), 10) for p in privs])
+    gd.validate_and_complete()
+    state = make_genesis_state(gd)
+    app = KVStoreApplication()
+    from tendermint_tpu.abci import types as abci
+
+    app.init_chain(abci.RequestInitChain(
+        chain_id=CHAIN_ID,
+        validators=[abci.ValidatorUpdate("ed25519", p.pub_key().bytes(), 10)
+                    for p in privs]))
+    state_store = StateStore(MemDB())
+    state_store.save(state)
+    block_store = BlockStore(MemDB())
+    evpool = EvidencePool(MemDB(), state_store, block_store)
+    bx = BlockExecutor(state_store, app, mempool=Mempool(app),
+                       evidence_pool=evpool, block_store=block_store)
+
+    # realign privs to the sorted validator order
+    by_addr = {p.pub_key().address(): p for p in privs}
+    privs = [by_addr[v.address] for v in state.validators.validators]
+
+    commits = {}
+    last_commit = Commit(height=0, round=0, block_id=BlockID(), signatures=[])
+    for h in range(1, 7):
+        proposer = state.validators.get_proposer()
+        block = bx.create_proposal_block(h, state, last_commit, proposer.address)
+        bid, commit = _commit_for(state, block, privs)
+        block_store.save_block(block, PartSet.from_data(block.marshal()), commit)
+        state, _ = bx.apply_block(state, bid, block)
+        commits[h] = commit
+        last_commit = commit
+    assert state.last_block_height == 6
+
+    # --- 2. light-chain view of the honest chain --------------------------
+    honest = {}
+    for h in range(1, 7):
+        blk = block_store.load_block(h)
+        vals = state_store.load_validators(h)
+        honest[h] = LightBlock(
+            signed_header=SignedHeader(blk.header, commits[h]),
+            validator_set=vals)
+
+    # --- 3. the lunatic block: two validators (2/4 power = 1/2 >= 1/3 of
+    # the common set) fabricate state at height 5 under a claimed 2-member
+    # validator set they fully control ----------------------------------
+    attackers = privs[:2]
+    claimed = ValidatorSet([Validator.new(p.pub_key(), 10) for p in attackers])
+    fake_header = dataclasses.replace(
+        honest[5].signed_header.header,
+        app_hash=b"\xde\xad" * 16,
+        validators_hash=claimed.hash(),
+        next_validators_hash=claimed.hash(),
+    )
+
+    class _FakeBlock:
+        def __init__(self, header):
+            self.header = header
+
+        def hash(self):
+            return self.header.hash()
+
+        def marshal(self):
+            return self.header.marshal()
+
+    _, fake_commit = _commit_for(state, _FakeBlock(fake_header), attackers,
+                                 signers=claimed)
+    fake_lb = LightBlock(signed_header=SignedHeader(fake_header, fake_commit),
+                         validator_set=claimed)
+    assert fake_lb.hash() != honest[5].hash()
+
+    # --- 4. light client with a lying primary and an honest witness -------
+    lying = dict(honest)
+    lying[5] = fake_lb
+    primary = MockProvider(CHAIN_ID, lying)
+    witness = MockProvider(CHAIN_ID, dict(honest))
+    client = Client(
+        CHAIN_ID,
+        TrustOptions(period_s=3 * 3600.0, height=1, hash=honest[1].hash()),
+        primary, [witness], DBStore(MemDB()),
+        verification_mode=SKIPPING,
+    )
+    now = Time(honest[6].signed_header.header.time.seconds + 5, 0)
+    try:
+        client.verify_light_block_at_height(5, now)
+        raise AssertionError("lying primary accepted without conflict")
+    except ErrConflictingHeaders:
+        pass
+
+    # the honest witness received evidence AGAINST THE PRIMARY naming the
+    # two attackers (lunatic extraction from the common set)
+    assert witness.evidences, "no evidence reported to the honest provider"
+    ev = witness.evidences[-1]
+    assert ev.conflicting_block.hash() == fake_lb.hash()
+    byz_addrs = {v.address for v in ev.byzantine_validators}
+    assert byz_addrs == {p.pub_key().address() for p in attackers}
+
+    # --- 5. the full node's pool verifies it (byzantine set re-derived and
+    # cross-checked against what the evidence carries) ---------------------
+    evpool.add_evidence(ev)
+    assert evpool.is_pending(ev), "evidence did not verify into the pool"
+
+    # --- 6. the evidence is proposed, ABCI sees ByzantineValidators, the
+    # kvstore slashes, and the valset drops the attackers two heights on --
+    attacker_pubs = {p.pub_key().bytes() for p in attackers}
+    assert attacker_pubs <= set(app.validators)
+    for h in (7, 8):
+        proposer = state.validators.get_proposer()
+        block = bx.create_proposal_block(h, state, last_commit, proposer.address)
+        if h == 7:
+            assert block.evidence, "pending evidence not included in proposal"
+        bid, commit = _commit_for(state, block, privs)
+        block_store.save_block(block, PartSet.from_data(block.marshal()), commit)
+        state, _ = bx.apply_block(state, bid, block)
+        last_commit = commit
+    # app slashed immediately at height 7's BeginBlock
+    assert not (attacker_pubs & set(app.validators)), "attackers not slashed"
+    # consensus valset applies the update at H+2 = 9
+    next_addrs = {v.address for v in state.next_validators.validators}
+    assert not ({p.pub_key().address() for p in attackers} & next_addrs)
+    assert evpool.is_committed(ev)
