@@ -1,0 +1,91 @@
+"""Perf regression gate for the consensus-path verify flushes (VERDICT r3
+weak #4/#8): verify_commit and verify_commit_light at 256 and 1024
+validators must stay BATCHED — exactly one kernel dispatch per call, the
+scalar fallback never taken — and complete within a generous wall-clock
+ceiling so a silent fall-back to serial verification (the reference's
+per-signature loop, types/validator_set.go:719) cannot land unnoticed.
+
+Flush counting is the hard gate; the wall-clock ceilings are sanity bounds
+chosen loose enough for the noisy 1-core CI host."""
+
+import time
+
+import pytest
+
+from tendermint_tpu.crypto import batch as cbatch
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.types.block import Commit, CommitSig
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import BLOCK_ID_FLAG_COMMIT, PRECOMMIT_TYPE, Vote
+
+CHAIN_ID = "perf-gate-chain"
+WALL_CEILING_S = {256: 20.0, 1024: 40.0}
+
+
+def _commit(n):
+    privs = [ed25519.gen_priv_key((i + 1).to_bytes(2, "big") * 16)
+             for i in range(n)]
+    vals = ValidatorSet([Validator.new(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    privs = [by_addr[v.address] for v in vals.validators]
+    bid = BlockID(hash=b"\x42" * 32,
+                  part_set_header=PartSetHeader(total=1, hash=b"\x43" * 32))
+    ts = Time(1_700_000_500, 0)
+    sigs = []
+    for i, (p, v) in enumerate(zip(privs, vals.validators)):
+        vote = Vote(type=PRECOMMIT_TYPE, height=3, round=0, block_id=bid,
+                    timestamp=ts, validator_address=v.address,
+                    validator_index=i)
+        sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, v.address, ts,
+                              p.sign(vote.sign_bytes(CHAIN_ID))))
+    return vals, Commit(height=3, round=0, block_id=bid, signatures=sigs)
+
+
+class _FlushCounter:
+    """Counts kernel dispatches vs scalar fallbacks through the verifier."""
+
+    def __init__(self, monkeypatch):
+        self.kernel = 0
+        self.scalar = 0
+        orig = cbatch._KernelBatchVerifier.dispatch
+        counter = self
+
+        def counted(vself):
+            small = len(vself._items) < cbatch.batch_min(
+                vself._batch_min_default)
+            if small:
+                counter.scalar += 1
+            else:
+                counter.kernel += 1
+            return orig(vself)
+
+        monkeypatch.setattr(cbatch._KernelBatchVerifier, "dispatch", counted)
+
+
+@pytest.mark.parametrize("n_vals", [256, 1024])
+def test_verify_commit_stays_batched(n_vals, monkeypatch):
+    vals, commit = _commit(n_vals)
+    # warm BOTH call shapes outside the gate (first-ever XLA compile of a
+    # new padded shape is O(minutes) and must not count against the ceiling)
+    vals.verify_commit(CHAIN_ID, commit.block_id, 3, commit)
+    vals.verify_commit_light(CHAIN_ID, commit.block_id, 3, commit)
+
+    fc = _FlushCounter(monkeypatch)
+    t0 = time.monotonic()
+    vals.verify_commit(CHAIN_ID, commit.block_id, 3, commit)
+    full_s = time.monotonic() - t0
+    assert fc.kernel == 1, f"verify_commit used {fc.kernel} kernel flushes"
+    assert fc.scalar == 0, "verify_commit fell back to the scalar loop"
+
+    t0 = time.monotonic()
+    vals.verify_commit_light(CHAIN_ID, commit.block_id, 3, commit)
+    light_s = time.monotonic() - t0
+    assert fc.kernel == 2, "verify_commit_light did not flush exactly once"
+    assert fc.scalar == 0
+
+    ceiling = WALL_CEILING_S[n_vals]
+    assert full_s < ceiling, f"verify_commit {full_s:.1f}s > {ceiling}s"
+    assert light_s < ceiling, f"verify_commit_light {light_s:.1f}s > {ceiling}s"
