@@ -235,13 +235,62 @@ def block(env, height=0):
     return {"block_id": _block_id_json(m.block_id), "block": _block_json(b)}
 
 
+def _parse_hash(hash: str) -> bytes:
+    """A 32-byte hash arrives as 64 hex chars (URI style) or base64 (JSON
+    style); 64 hex chars can't be valid base64 for 32 bytes, so length
+    disambiguates."""
+    if len(hash) == 64 and all(c in "0123456789abcdefABCDEF" for c in hash):
+        return bytes.fromhex(hash)
+    return base64.b64decode(hash)
+
+
 def block_by_hash(env, hash=""):
-    raw = base64.b64decode(hash) if not all(c in "0123456789abcdefABCDEF" for c in hash) else bytes.fromhex(hash)
-    b = env.node.block_store.load_block_by_hash(raw)
+    b = env.node.block_store.load_block_by_hash(_parse_hash(hash))
     if b is None:
         return {"block_id": None, "block": None}
     m = env.node.block_store.load_block_meta(b.header.height)
     return {"block_id": _block_id_json(m.block_id), "block": _block_json(b)}
+
+
+def block_search(env, query="", page=1, per_page=30, order_by=""):
+    """reference: rpc/core/blocks.go:113 BlockSearch (kv block indexer;
+    empty order_by defaults to desc, anything else than asc/desc errors)."""
+    indexer = getattr(env.node, "block_indexer", None)
+    if indexer is None:
+        raise ValueError("block indexing is disabled")
+    heights = indexer.search(query)
+    if order_by in ("desc", ""):
+        heights = list(reversed(heights))
+    elif order_by != "asc":
+        raise ValueError("expected order_by to be either `asc` or `desc`")
+    page, per_page = max(int(page), 1), min(max(int(per_page), 1), 100)
+    start = (page - 1) * per_page
+    blocks = []
+    for h in heights[start:start + per_page]:
+        b = env.node.block_store.load_block(h)
+        m = env.node.block_store.load_block_meta(h)
+        if b is not None and m is not None:
+            blocks.append({"block_id": _block_id_json(m.block_id),
+                           "block": _block_json(b)})
+    return {"blocks": blocks, "total_count": str(len(heights))}
+
+
+def header(env, height=0):
+    """reference: rpc/core/blocks.go:95 Header."""
+    store = env.node.block_store
+    h = int(height) or store.height
+    m = store.load_block_meta(h)
+    if m is None:
+        raise ValueError(f"could not find header at height {h}")
+    return {"header": _header_json(m.header)}
+
+
+def header_by_hash(env, hash=""):
+    """reference: rpc/core/blocks.go:105 HeaderByHash."""
+    b = env.node.block_store.load_block_by_hash(_parse_hash(hash))
+    if b is None:
+        return {"header": None}
+    return {"header": _header_json(b.header)}
 
 
 def block_results(env, height=0):
@@ -437,6 +486,28 @@ def tx(env, hash="", prove=False):
     res = indexer.get(raw)
     if res is None:
         raise ValueError(f"tx ({_hex(raw)}) not found")
+    if prove:
+        # Merkle inclusion proof against the block's data hash (reference:
+        # rpc/core/tx.go:47 + types/tx.go Txs.Proof; RFC 6962 tree).
+        from tendermint_tpu.types.tx import txs_proof
+
+        block = env.node.block_store.load_block(int(res["height"]))
+        if block is None:
+            # A proof cannot be constructed for a pruned block; degrading
+            # to a proof-less result would read as "verified".
+            raise ValueError(
+                f"block at height {res['height']} not available for proof")
+        idx = int(res["index"])
+        txs = block.data.txs
+        root, p = txs_proof(list(txs), idx)
+        res = dict(res)
+        res["proof"] = {
+            "root_hash": _hex(root),
+            "data": _b64(txs[idx]),
+            "proof": {"total": str(p.total), "index": str(p.index),
+                      "leaf_hash": _b64(p.leaf_hash),
+                      "aunts": [_b64(a) for a in p.aunts]},
+        }
     return res
 
 
@@ -520,6 +591,9 @@ ROUTES = {
     "blockchain": blockchain,
     "block": block,
     "block_by_hash": block_by_hash,
+    "block_search": block_search,
+    "header": header,
+    "header_by_hash": header_by_hash,
     "block_results": block_results,
     "commit": commit,
     "light_block": light_block,

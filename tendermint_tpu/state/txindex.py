@@ -7,8 +7,13 @@ Index layout (kv backend):
   txe/<key>/<value>/<h>/<i> -> hash  (event postings, incl. tx.height)
   blk/<key>/<value>/<h>     -> height (block events from Begin/EndBlock)
 
-Search is the AND of per-condition posting scans, preserving the reference's
-query semantics for the `key=value` subset of the query language.
+Search is the AND of per-condition posting scans: `=` conditions hit exact
+posting prefixes; range/CONTAINS/EXISTS conditions scan the key's postings
+and filter values (full reference operator grammar,
+libs/pubsub/query/query.go). The reference's psql sink
+(state/indexer/sink/psql) has no analogue here: this image ships no
+postgres driver, and the kv sink is the one the reference enables by
+default.
 """
 
 from __future__ import annotations
@@ -76,17 +81,33 @@ class TxIndexer:
         raw = self._db.get(b"txr/" + h)
         return json.loads(raw) if raw is not None else None
 
+    def _scan(self, key: str, op: str, value: str | None) -> set[bytes]:
+        """Candidate tx hashes for one condition (reference: kv.go:133
+        Search + matchRange). `=` hits the exact posting prefix; range /
+        CONTAINS / EXISTS conditions scan the key's postings and filter
+        the posted values."""
+        if op == "=":
+            prefix = f"txe/{_esc(key)}/{_esc(value)}/".encode()
+            return {v for _, v in self._db.iterator(prefix, prefix_end(prefix))}
+        prefix = f"txe/{_esc(key)}/".encode()
+        found = set()
+        for k, h in self._db.iterator(prefix, prefix_end(prefix)):
+            posted = k.decode().split("/")[2].replace("%2F", "/")
+            if op == "exists" or tmevents.Query._cmp(op, posted, value):
+                found.add(h)
+        return found
+
     def search(self, query: str) -> list[dict]:
-        """AND of key=value conditions (reference: kv.go:133 Search)."""
+        """AND of conditions over the event postings; supports the full
+        operator grammar (=, <, <=, >, >=, CONTAINS, EXISTS)."""
         q = tmevents.Query(query)
-        conditions = [(k, v) for k, v in q.conditions if v is not None
-                      and k != tmevents.EVENT_TYPE_KEY]
+        conditions = [c for c in q.conditions
+                      if c[0] != tmevents.EVENT_TYPE_KEY]
         if not conditions:
             return []
         result_hashes: set[bytes] | None = None
-        for key, value in conditions:
-            prefix = f"txe/{_esc(key)}/{_esc(value)}/".encode()
-            found = {v for _, v in self._db.iterator(prefix, prefix_end(prefix))}
+        for key, op, value in conditions:
+            found = self._scan(key, op, value)
             result_hashes = found if result_hashes is None else (result_hashes & found)
             if not result_hashes:
                 return []
@@ -126,17 +147,32 @@ class BlockIndexer:
 
     def search(self, query: str) -> list[int]:
         q = tmevents.Query(query)
-        conditions = [(k, v) for k, v in q.conditions if v is not None
-                      and k != tmevents.EVENT_TYPE_KEY]
+        conditions = [c for c in q.conditions
+                      if c[0] != tmevents.EVENT_TYPE_KEY]
         if not conditions:
             return []
         heights: set[int] | None = None
-        for key, value in conditions:
+        for key, op, value in conditions:
             if key == "block.height":
-                found = {int(value)} if self.has(int(value)) else set()
-            else:
+                if op == "=":
+                    found = {int(value)} if self.has(int(value)) else set()
+                else:
+                    prefix = b"blkh/"
+                    found = {
+                        int(v) for _, v in
+                        self._db.iterator(prefix, prefix_end(prefix))
+                        if op == "exists"
+                        or tmevents.Query._cmp(op, v.decode(), value)}
+            elif op == "=":
                 prefix = f"blk/{_esc(key)}/{_esc(value)}/".encode()
                 found = {int(v) for _, v in self._db.iterator(prefix, prefix_end(prefix))}
+            else:
+                prefix = f"blk/{_esc(key)}/".encode()
+                found = set()
+                for k, v in self._db.iterator(prefix, prefix_end(prefix)):
+                    posted = k.decode().split("/")[2].replace("%2F", "/")
+                    if op == "exists" or tmevents.Query._cmp(op, posted, value):
+                        found.add(int(v))
             heights = found if heights is None else (heights & found)
             if not heights:
                 return []

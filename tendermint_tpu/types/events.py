@@ -9,6 +9,7 @@ uses: "tm.event='NewBlock'" style equality conditions joined by AND
 from __future__ import annotations
 
 import fnmatch
+import re
 import threading
 from dataclasses import dataclass, field
 
@@ -104,28 +105,92 @@ class EventDataString:
     value: str = ""
 
 
+# value operand: a quoted string or a single bare token (number, hex hash,
+# glob pattern) — anything else is a parse error, as in the reference parser
+_VAL = r"'[^']*'|\"[^\"]*\"|[\w.+\-:*?\[\]]+"
+_COND_RE = re.compile(
+    r"^(?P<key>[\w.\-/]+)\s*"
+    rf"(?:(?P<op><=|>=|=|<|>)\s*(?P<val>{_VAL})"
+    rf"|\s(?P<word>CONTAINS)\s+(?P<cval>{_VAL})"
+    r"|\s(?P<exists>EXISTS))$"
+)
+
+
+def _split_and(expr: str) -> list[str]:
+    """Split on AND outside quotes (a quoted value may contain ' AND ')."""
+    parts, buf, quote = [], [], ""
+    i = 0
+    while i < len(expr):
+        c = expr[i]
+        if quote:
+            if c == quote:
+                quote = ""
+            buf.append(c)
+        elif c in "'\"":
+            quote = c
+            buf.append(c)
+        elif expr.startswith(" AND ", i):
+            parts.append("".join(buf))
+            buf = []
+            i += 4
+        else:
+            buf.append(c)
+        i += 1
+    parts.append("".join(buf))
+    return parts
+
+
 class Query:
-    """Minimal pubsub query: AND of key=value / key EXISTS conditions, plus
-    glob on values (reference: libs/pubsub/query)."""
+    """Pubsub query: AND of conditions over event attributes with the
+    reference grammar's operators =, <, <=, >, >=, CONTAINS, EXISTS
+    (reference: libs/pubsub/query/query.go). Comparison operators apply
+    numerically (heights, amounts); `=` additionally supports glob
+    patterns on string values (a superset of the reference's exact match).
+
+    conditions: list of (key, op, value) with op in
+    {"=", "<", "<=", ">", ">=", "contains", "exists"}; value is None for
+    exists."""
 
     def __init__(self, expr: str):
         self.expr = expr.strip()
-        self.conditions: list[tuple[str, str | None]] = []
+        self.conditions: list[tuple[str, str, str | None]] = []
         if self.expr:
-            for part in self.expr.split(" AND "):
-                part = part.strip()
-                if "=" in part:
-                    k, v = part.split("=", 1)
-                    self.conditions.append((k.strip(), v.strip().strip("'\"")))
-                elif part.endswith(" EXISTS"):
-                    self.conditions.append((part[:-7].strip(), None))
+            for part in _split_and(self.expr):
+                m = _COND_RE.match(part.strip())
+                if not m:
+                    raise ValueError(f"bad query condition: {part!r}")
+                key = m.group("key")
+                if m.group("exists"):
+                    self.conditions.append((key, "exists", None))
+                elif m.group("word"):
+                    self.conditions.append(
+                        (key, "contains", m.group("cval").strip().strip("'\"")))
+                else:
+                    self.conditions.append(
+                        (key, m.group("op"),
+                         m.group("val").strip().strip("'\"")))
+
+    @staticmethod
+    def _cmp(op: str, x: str, v: str) -> bool:
+        if op == "=":
+            return x == v or fnmatch.fnmatchcase(x, v)
+        if op == "contains":
+            return v in x
+        try:
+            xn, vn = float(x), float(v)
+        except ValueError:
+            return False  # comparison operators are numeric (ref: TIME/
+        return {"<": xn < vn, "<=": xn <= vn,  # DATE operands not supported)
+                ">": xn > vn, ">=": xn >= vn}[op]
 
     def matches(self, events: dict[str, list[str]]) -> bool:
-        for k, v in self.conditions:
+        for k, op, v in self.conditions:
             vals = events.get(k)
             if vals is None:
                 return False
-            if v is not None and not any(fnmatch.fnmatchcase(x, v) for x in vals):
+            if op == "exists":
+                continue
+            if not any(self._cmp(op, x, v) for x in vals):
                 return False
         return True
 

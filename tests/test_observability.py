@@ -108,6 +108,48 @@ def test_block_indexer_search():
     assert idx.search("rewards.epoch=4 AND block.height=5") == [5]
 
 
+def test_query_language_operators():
+    """The reference grammar's comparison operators (libs/pubsub/query/
+    query.go): <, <=, >, >=, CONTAINS, EXISTS — in the pubsub matcher and
+    in both kv indexers (VERDICT r3 missing #6)."""
+    from tendermint_tpu.types.events import Query
+
+    q = Query("tx.height>5 AND app.key='x'")
+    assert q.matches({"tx.height": ["9"], "app.key": ["x"]})
+    assert not q.matches({"tx.height": ["5"], "app.key": ["x"]})
+    assert not q.matches({"tx.height": ["9"], "app.key": ["y"]})
+    assert Query("a.b CONTAINS 'ell'").matches({"a.b": ["hello"]})
+    assert not Query("a.b CONTAINS 'z'").matches({"a.b": ["hello"]})
+    assert Query("a.b EXISTS").matches({"a.b": ["1"]})
+    assert not Query("a.b EXISTS").matches({"c.d": ["1"]})
+    assert Query("x.n<=3 AND x.n>=3").matches({"x.n": ["3"]})
+
+    # tx indexer: ranges + CONTAINS + EXISTS over postings
+    idx = TxIndexer(MemDB())
+    ev = [abci.Event(type="transfer", attributes=[
+        abci.EventAttribute(key=b"sender", value=b"alice", index=True)])]
+    for h, i, tx in ((5, 0, b"q-a"), (7, 0, b"q-b"), (9, 0, b"q-c")):
+        idx.index(h, i, tx, _mk_result(ev if h != 7 else None))
+    assert [d["height"] for d in idx.search("tx.height>5")] == ["7", "9"]
+    assert [d["height"] for d in idx.search("tx.height>5 AND tx.height<9")] == ["7"]
+    assert [d["height"] for d in
+            idx.search("tx.height>=5 AND transfer.sender='alice'")] == ["5", "9"]
+    assert [d["height"] for d in
+            idx.search("transfer.sender CONTAINS 'lic' AND tx.height<6")] == ["5"]
+    assert [d["height"] for d in
+            idx.search("transfer.sender EXISTS AND tx.height>8")] == ["9"]
+
+    # block indexer: height ranges + event-value ranges
+    bidx = BlockIndexer(MemDB())
+    for h, epoch in ((3, b"4"), (5, b"4"), (8, b"6")):
+        bidx.index(h, [abci.Event(type="rewards", attributes=[
+            abci.EventAttribute(key=b"epoch", value=epoch, index=True)])], [])
+    assert bidx.search("block.height>3") == [5, 8]
+    assert bidx.search("block.height>=3 AND block.height<8") == [3, 5]
+    assert bidx.search("rewards.epoch>4") == [8]
+    assert bidx.search("rewards.epoch EXISTS AND block.height<=5") == [3, 5]
+
+
 def test_localnet_metrics_and_tx_search(tmp_path):
     """The VERDICT criterion: metrics scrapeable; tx_search returns an
     indexed tx."""

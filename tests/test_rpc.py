@@ -76,11 +76,50 @@ def test_rpc_surface(tmp_path):
         gen = _rpc(base, "genesis")
         assert gen["genesis"]["chain_id"] == "rpc-chain"
 
+        # header / header_by_hash (reference: rpc/core/blocks.go:95-112)
+        hd = _rpc(base, "header", {"height": 1})
+        assert hd["header"]["height"] == "1"
+        hdh = _rpc(base, "header_by_hash", {"hash": b["block_id"]["hash"]})
+        assert hdh["header"]["height"] == "1"
+
         # broadcast_tx_commit waits for the block
         tx = base64.b64encode(b"rpc=tx").decode()
         res = _rpc(base, "broadcast_tx_commit", {"tx": tx})
         assert res["deliver_tx"]["code"] == 0
         assert int(res["height"]) > 0
+        tx_height = int(res["height"])
+
+        # tx with prove=true returns a Merkle inclusion proof that verifies
+        # against the block's data_hash (reference: rpc/core/tx.go:47)
+        from tendermint_tpu.crypto.merkle import Proof
+        from tendermint_tpu.types.tx import tx_hash
+
+        txr = _rpc(base, "tx", {"hash": base64.b64encode(
+            tx_hash(b"rpc=tx")).decode(), "prove": True})
+        proof_doc = txr["proof"]["proof"]
+        p = Proof(total=int(proof_doc["total"]), index=int(proof_doc["index"]),
+                  leaf_hash=base64.b64decode(proof_doc["leaf_hash"]),
+                  aunts=[base64.b64decode(a) for a in proof_doc["aunts"]])
+        blk_doc = _rpc(base, "block", {"height": tx_height})
+        root = bytes.fromhex(txr["proof"]["root_hash"].lower())
+        assert p.compute_root_hash() == root
+        assert blk_doc["block"]["header"]["data_hash"].lower() == root.hex()
+
+        # block_search over the block indexer with a height-range query
+        # (the indexer drains the event bus asynchronously: retry briefly)
+        bs = {"total_count": "0"}
+        bs_deadline = time.monotonic() + 10
+        while time.monotonic() < bs_deadline and int(bs["total_count"]) < 1:
+            bs = _rpc(base, "block_search",
+                      {"query": f"block.height>{tx_height - 1} AND "
+                                f"block.height<={tx_height}"})
+            time.sleep(0.2)
+        assert int(bs["total_count"]) >= 1
+        assert bs["blocks"][0]["block"]["header"]["height"] == str(tx_height)
+
+        # tx_search with a comparison operator
+        ts = _rpc(base, "tx_search", {"query": f"tx.height>={tx_height}"})
+        assert int(ts["total_count"]) >= 1
 
         # abci_query sees it after commit
         q = _rpc(base, "abci_query", {"path": "", "data": b"rpc".hex()})
