@@ -1,0 +1,76 @@
+"""Counter example application (reference: abci/example/counter/counter.go).
+
+A tx is a big-endian integer (at most 8 bytes). In serial mode DeliverTx
+requires each tx to equal the current count (a strict nonce) and CheckTx
+requires it to be >= the count; Commit's app hash is the big-endian tx
+count once any tx has been delivered. Query paths: "hash" (commit count)
+and "tx" (tx count). Error codes mirror abci/example/code/code.go.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.abci import types as abci
+
+CODE_TYPE_ENCODING_ERROR = 1
+CODE_TYPE_BAD_NONCE = 2
+
+
+class CounterApp(abci.Application):
+    def __init__(self, serial: bool = False):
+        self.serial = serial
+        self.hash_count = 0
+        self.tx_count = 0
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data='{"hashes":%d,"txs":%d}' % (self.hash_count, self.tx_count))
+
+    def set_option(self, key: str, value: str) -> abci.ResponseSetOption:
+        if key == "serial" and value == "on":
+            self.serial = True
+        return abci.ResponseSetOption()
+
+    def _tx_value(self, tx: bytes) -> int | None:
+        return int.from_bytes(tx, "big") if len(tx) <= 8 else None
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        if self.serial:
+            value = self._tx_value(req.tx)
+            if value is None:
+                return abci.ResponseDeliverTx(
+                    code=CODE_TYPE_ENCODING_ERROR,
+                    log=f"Max tx size is 8 bytes, got {len(req.tx)}")
+            if value != self.tx_count:
+                return abci.ResponseDeliverTx(
+                    code=CODE_TYPE_BAD_NONCE,
+                    log=f"Invalid nonce. Expected {self.tx_count}, got {value}")
+        self.tx_count += 1
+        return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        if self.serial:
+            value = self._tx_value(req.tx)
+            if value is None:
+                return abci.ResponseCheckTx(
+                    code=CODE_TYPE_ENCODING_ERROR,
+                    log=f"Max tx size is 8 bytes, got {len(req.tx)}")
+            if value < self.tx_count:
+                return abci.ResponseCheckTx(
+                    code=CODE_TYPE_BAD_NONCE,
+                    log=f"Invalid nonce. Expected >= {self.tx_count}, "
+                        f"got {value}")
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK)
+
+    def commit(self) -> abci.ResponseCommit:
+        self.hash_count += 1
+        if self.tx_count == 0:
+            return abci.ResponseCommit()
+        return abci.ResponseCommit(data=self.tx_count.to_bytes(8, "big"))
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        if req.path == "hash":
+            return abci.ResponseQuery(value=str(self.hash_count).encode())
+        if req.path == "tx":
+            return abci.ResponseQuery(value=str(self.tx_count).encode())
+        return abci.ResponseQuery(
+            log=f"Invalid query path. Expected hash or tx, got {req.path}")

@@ -447,17 +447,27 @@ def cmd_probe_upnp(args) -> int:
 
 
 def cmd_abci_server(args) -> int:
-    """Run the kvstore app behind an ABCI socket (reference:
-    abci/cmd/abci-cli: kvstore subcommand)."""
-    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    """Run an example app behind an ABCI socket (reference:
+    abci/cmd/abci-cli: kvstore and counter subcommands)."""
     from tendermint_tpu.abci.server import ABCIServer
     from tendermint_tpu.store.db import new_db
 
-    db = new_db("sqlite", args.db) if args.db else None
-    app = KVStoreApplication(db, snapshot_interval=args.snapshot_interval)
+    if args.app == "counter":
+        from tendermint_tpu.abci.counter import CounterApp
+
+        if args.db or args.snapshot_interval:
+            print("abci-server: --db/--snapshot-interval apply only to "
+                  "kvstore", file=sys.stderr)
+            return 1
+        app = CounterApp(serial=args.serial)
+    else:
+        from tendermint_tpu.abci.kvstore import KVStoreApplication
+
+        db = new_db("sqlite", args.db) if args.db else None
+        app = KVStoreApplication(db, snapshot_interval=args.snapshot_interval)
     server = ABCIServer(app, args.address)
     server.start()
-    print(f"ABCI kvstore server listening on {server.addr}")
+    print(f"ABCI {args.app} server listening on {server.addr}")
     stop = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
@@ -536,8 +546,11 @@ def main(argv=None) -> int:
     sp.add_argument("--timeout", type=float, default=3.0)
     sp.set_defaults(fn=cmd_probe_upnp)
 
-    sp = sub.add_parser("abci-server", help="run the kvstore app behind a socket")
+    sp = sub.add_parser("abci-server", help="run an example app behind a socket")
     sp.add_argument("--address", default="tcp://127.0.0.1:26658")
+    sp.add_argument("--app", default="kvstore", choices=["kvstore", "counter"])
+    sp.add_argument("--serial", action="store_true",
+                    help="counter: enforce serial nonces")
     sp.add_argument("--db", default="", help="sqlite path for persistence")
     sp.add_argument("--snapshot-interval", type=int, default=0)
     sp.set_defaults(fn=cmd_abci_server)

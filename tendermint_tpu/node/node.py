@@ -37,6 +37,14 @@ def default_app(name: str):
         # takes snapshot_interval from its manifest; env keeps the CLI thin)
         interval = int(os.environ.get("TMTPU_KVSTORE_SNAPSHOT_INTERVAL", "0"))
         return KVStoreApplication(snapshot_interval=interval)
+    if name == "counter":
+        from tendermint_tpu.abci.counter import CounterApp
+
+        return CounterApp()
+    if name == "counter_serial":
+        from tendermint_tpu.abci.counter import CounterApp
+
+        return CounterApp(serial=True)
     if name == "noop":
         from tendermint_tpu.abci.types import Application
 
@@ -202,6 +210,7 @@ class Node:
         self.tx_indexer = None
         self.block_indexer = None
         self.indexer_service = None
+        self.event_sink = None
         if config.tx_index.indexer == "kv":
             from tendermint_tpu.state.txindex import (
                 BlockIndexer,
@@ -213,6 +222,22 @@ class Node:
                             if backend != "memdb" else None)
             self.tx_indexer = TxIndexer(idx_db)
             self.block_indexer = BlockIndexer(idx_db)
+            self.indexer_service = IndexerService(
+                self.tx_indexer, self.block_indexer, self.event_bus, logger)
+        elif config.tx_index.indexer == "psql":
+            # Write-only SQL sink (reference: node/node.go:282-299 "psql");
+            # tx/block search RPCs report unsupported, as upstream.
+            from tendermint_tpu.state.sql_sink import SqlEventSink, connect
+            from tendermint_tpu.state.txindex import IndexerService
+
+            if not config.tx_index.psql_conn:
+                raise ValueError(
+                    "the psql indexer requires tx_index.psql_conn")
+            sink = SqlEventSink(connect(config.tx_index.psql_conn),
+                                self.genesis.chain_id)
+            self.event_sink = sink
+            self.tx_indexer = sink.tx_indexer()
+            self.block_indexer = sink.block_indexer()
             self.indexer_service = IndexerService(
                 self.tx_indexer, self.block_indexer, self.event_bus, logger)
 
@@ -350,6 +375,8 @@ class Node:
             self.grpc_server.stop()
         if self.indexer_service is not None:
             self.indexer_service.stop()
+        if self.event_sink is not None:
+            self.event_sink.stop()
         if self.metrics_server is not None:
             self.metrics_server.stop()
         self.consensus.stop()

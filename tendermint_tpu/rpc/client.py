@@ -1,0 +1,307 @@
+"""RPC client library (reference: rpc/client/{interface.go,http/http.go,
+local/local.go}).
+
+Two implementations of one surface:
+
+ * ``HTTPClient`` — JSON-RPC 2.0 over HTTP POST, plus a WebSocket
+   ``subscribe`` that yields events as they arrive (the reference http
+   client's wsEvents, rpc/client/http/http.go:370).
+ * ``LocalClient`` — direct in-process calls into the node's RPC
+   environment, no sockets (rpc/client/local/local.go:23: "directly calls
+   the methods the RPC server would"), with ``subscribe`` served straight
+   off the EventBus.
+
+Every method name matches the route it drives (rpc/core/routes.go:12-48),
+and both clients raise ``RPCClientError`` on an error response, carrying
+the server's code/message/data.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+import threading
+import urllib.request
+from urllib.parse import urlparse
+
+WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+class RPCClientError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(f"RPC error {code}: {message}"
+                         + (f" ({data})" if data else ""))
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+def _b64(raw: bytes) -> str:
+    return base64.b64encode(raw).decode()
+
+
+# Route name -> parameter names, generated onto both clients. Parameters are
+# passed through as JSON-RPC params verbatim; defaults live server-side.
+_METHODS = {
+    "health": (),
+    "status": (),
+    "net_info": (),
+    "genesis": (),
+    "genesis_chunked": ("chunk",),
+    "blockchain": ("minHeight", "maxHeight"),
+    "block": ("height",),
+    "block_by_hash": ("hash",),
+    "block_search": ("query", "page", "per_page", "order_by"),
+    "header": ("height",),
+    "header_by_hash": ("hash",),
+    "block_results": ("height",),
+    "commit": ("height",),
+    "light_block": ("height",),
+    "validators": ("height", "page", "per_page"),
+    "consensus_params": ("height",),
+    "consensus_state": (),
+    "dump_consensus_state": (),
+    "unconfirmed_txs": ("limit",),
+    "num_unconfirmed_txs": (),
+    "tx_search": ("query", "prove", "page", "per_page", "order_by"),
+    "abci_info": (),
+}
+
+
+class _ClientBase:
+    """Shared method generation; subclasses provide _call(method, params)."""
+
+    def __getattr__(self, name):
+        sig = _METHODS.get(name)
+        if sig is None:
+            raise AttributeError(name)
+
+        def method(*args, **kw):
+            if len(args) > len(sig):
+                raise TypeError(f"{name} takes at most {len(sig)} arguments")
+            params = dict(zip(sig, args))
+            params.update(kw)
+            return self._call(name, {k: v for k, v in params.items()
+                                     if v is not None})
+
+        method.__name__ = name
+        return method
+
+    # -- byte-argument helpers (reference http client marshals these,
+    # rpc/client/http/http.go:280-350) ---------------------------------------
+
+    def broadcast_tx_sync(self, tx: bytes):
+        return self._call("broadcast_tx_sync", {"tx": _b64(tx)})
+
+    def broadcast_tx_async(self, tx: bytes):
+        return self._call("broadcast_tx_async", {"tx": _b64(tx)})
+
+    def broadcast_tx_commit(self, tx: bytes):
+        return self._call("broadcast_tx_commit", {"tx": _b64(tx)})
+
+    def check_tx(self, tx: bytes):
+        return self._call("check_tx", {"tx": _b64(tx)})
+
+    def tx(self, hash: bytes, prove: bool = False):
+        return self._call("tx", {"hash": _b64(hash), "prove": prove})
+
+    def abci_query(self, path: str, data: bytes, height: int = 0,
+                   prove: bool = False):
+        return self._call("abci_query", {
+            "path": path, "data": data.hex(), "height": height,
+            "prove": prove})
+
+    def broadcast_evidence(self, ev_hex: str):
+        return self._call("broadcast_evidence", {"evidence": ev_hex})
+
+
+class HTTPClient(_ClientBase):
+    """reference: rpc/client/http/http.go:28 HTTP."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        if base_url.startswith("tcp://"):
+            base_url = "http://" + base_url[len("tcp://"):]
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._id = 0
+        self._id_mtx = threading.Lock()
+
+    def remote(self) -> str:
+        return self.base_url
+
+    def _next_id(self) -> int:
+        with self._id_mtx:
+            self._id += 1
+            return self._id
+
+    def _call(self, method: str, params: dict):
+        body = json.dumps({"jsonrpc": "2.0", "id": self._next_id(),
+                           "method": method, "params": params}).encode()
+        req = urllib.request.Request(
+            self.base_url, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            doc = json.loads(r.read())
+        if "error" in doc:
+            e = doc["error"]
+            raise RPCClientError(e.get("code", -1), e.get("message", ""),
+                                 e.get("data", ""))
+        return doc["result"]
+
+    def subscribe(self, query: str, timeout: float | None = None):
+        """Yield event payloads matching ``query`` over a dedicated
+        WebSocket. Each yield is the subscription result dict
+        ({"query", "data", "events"}). Generator close() tears the socket
+        down. ``timeout`` bounds the wait for EACH event."""
+        u = urlparse(self.base_url)
+        host, port = u.hostname, u.port or 80
+        conn = socket.create_connection((host, port),
+                                        timeout=timeout or self.timeout)
+        try:
+            key = base64.b64encode(os.urandom(16)).decode()
+            conn.sendall((
+                f"GET /websocket HTTP/1.1\r\nHost: {host}\r\n"
+                f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\n"
+                f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+            resp = b""
+            while b"\r\n\r\n" not in resp:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    raise ConnectionError("websocket handshake failed")
+                resp += chunk
+            if b"101" not in resp.split(b"\r\n", 1)[0]:
+                raise ConnectionError("websocket upgrade refused")
+            expect = base64.b64encode(hashlib.sha1(
+                (key + WS_GUID).encode()).digest())
+            if expect not in resp:
+                raise ConnectionError("bad Sec-WebSocket-Accept")
+            _ws_send(conn, json.dumps({
+                "jsonrpc": "2.0", "id": 1, "method": "subscribe",
+                "params": {"query": query}}).encode())
+            # First frame is the subscribe ack.
+            ack = json.loads(_ws_recv(conn))
+            if "error" in ack:
+                e = ack["error"]
+                raise RPCClientError(e.get("code", -1), e.get("message", ""),
+                                     e.get("data", ""))
+            while True:
+                doc = json.loads(_ws_recv(conn))
+                if "error" in doc:
+                    e = doc["error"]
+                    raise RPCClientError(e.get("code", -1),
+                                         e.get("message", ""),
+                                         e.get("data", ""))
+                result = doc.get("result") or {}
+                if result:
+                    yield result
+        finally:
+            conn.close()
+
+
+def _ws_send(conn: socket.socket, payload: bytes) -> None:
+    """One masked text frame (clients MUST mask, RFC 6455 §5.3)."""
+    mask = os.urandom(4)
+    hdr = bytearray([0x81])
+    n = len(payload)
+    if n < 126:
+        hdr.append(0x80 | n)
+    elif n < 65536:
+        hdr.append(0x80 | 126)
+        hdr += struct.pack(">H", n)
+    else:
+        hdr.append(0x80 | 127)
+        hdr += struct.pack(">Q", n)
+    hdr += mask
+    conn.sendall(bytes(hdr)
+                 + bytes(b ^ mask[i % 4] for i, b in enumerate(payload)))
+
+
+def _ws_recv(conn: socket.socket) -> bytes:
+    while True:
+        hdr = _read_n(conn, 2)
+        b0, b1 = hdr
+        opcode = b0 & 0x0F
+        ln = b1 & 0x7F
+        if ln == 126:
+            (ln,) = struct.unpack(">H", _read_n(conn, 2))
+        elif ln == 127:
+            (ln,) = struct.unpack(">Q", _read_n(conn, 8))
+        mask = _read_n(conn, 4) if b1 & 0x80 else None
+        payload = _read_n(conn, ln) if ln else b""
+        if mask:
+            payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        if opcode == 0x8:
+            raise ConnectionError("websocket closed by server")
+        if opcode == 0x9:  # ping -> pong
+            conn.sendall(bytes([0x8A, len(payload)]) + payload)
+            continue
+        if payload == b"":  # server's pong or empty frame
+            continue
+        return payload
+
+
+def _read_n(conn: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("websocket closed mid-frame")
+        buf += chunk
+    return buf
+
+
+class LocalClient(_ClientBase):
+    """reference: rpc/client/local/local.go:40 New."""
+
+    SUBSCRIBER = "rpc-local-client"
+
+    def __init__(self, node):
+        from tendermint_tpu.rpc import core as rpc_core
+
+        self._env = rpc_core.Environment(node)
+        self._routes = rpc_core.ROUTES
+        self._node = node
+        self._sub_seq = 0
+        self._sub_mtx = threading.Lock()
+
+    def remote(self) -> str:
+        return "local"
+
+    def _call(self, method: str, params: dict):
+        fn = self._routes.get(method)
+        if fn is None:
+            raise RPCClientError(-32601, "Method not found", method)
+        try:
+            return fn(self._env, **params)
+        except RPCClientError:
+            raise
+        except Exception as e:  # noqa: BLE001 - mirror the server boundary
+            raise RPCClientError(-32603, "Internal error", str(e)) from e
+
+    def subscribe(self, query: str, timeout: float | None = None):
+        from tendermint_tpu.rpc import core as rpc_core
+
+        with self._sub_mtx:
+            self._sub_seq += 1
+            subscriber = f"{self.SUBSCRIBER}-{self._sub_seq}"
+        sub = self._node.event_bus.subscribe(subscriber, query)
+        try:
+            while True:
+                msg = sub.next(timeout=timeout or 1.0)
+                if msg is None:
+                    if sub.cancelled:
+                        return
+                    continue
+                yield {"query": query,
+                       "data": rpc_core.encode_event_data(msg.data),
+                       "events": msg.events}
+        finally:
+            try:
+                self._node.event_bus.unsubscribe_all(subscriber)
+            except ValueError:
+                pass

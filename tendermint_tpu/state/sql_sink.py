@@ -1,0 +1,309 @@
+"""SQL event sink: the analogue of the reference's PostgreSQL indexer sink
+(state/indexer/sink/psql/{psql.go,schema.sql,backport.go}).
+
+The sink writes normalized block/tx/event/attribute rows through any DB-API
+2.0 connection. The schema is the reference's (blocks, tx_results, events,
+attributes + the event_attributes/block_events/tx_events views); only the
+auto-increment spelling differs per dialect. This image ships no postgres
+driver, so the tested backend is the stdlib ``sqlite3`` (>=3.35 for
+RETURNING); a psycopg2 connection works unchanged — the dialect is picked
+from the driver module's ``paramstyle``.
+
+Like the reference sink, this is write-only: reads (``get``/``search``/
+``has``) are served by the kv indexer, and the backport adapters raise for
+them (backport.go:52-61,74-77,86-89). One deviation: ``tx_result`` stores
+the JSON document this framework serves over RPC rather than a protobuf
+``TxResult`` message (psql.go:182) — this repo's wire analogue for indexed
+results is JSON throughout (state/txindex.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+# The reference schema, dialect-parameterized: {PK} is the auto-increment
+# primary-key spelling ("BIGSERIAL PRIMARY KEY" on postgres,
+# "INTEGER PRIMARY KEY AUTOINCREMENT" on sqlite).
+SCHEMA = """
+CREATE TABLE IF NOT EXISTS blocks (
+  rowid      {PK},
+  height     BIGINT NOT NULL,
+  chain_id   VARCHAR NOT NULL,
+  created_at VARCHAR NOT NULL,
+  UNIQUE (height, chain_id)
+);
+CREATE INDEX IF NOT EXISTS idx_blocks_height_chain ON blocks(height, chain_id);
+CREATE TABLE IF NOT EXISTS tx_results (
+  rowid      {PK},
+  block_id   BIGINT NOT NULL REFERENCES blocks(rowid),
+  tx_index   INTEGER NOT NULL,
+  created_at VARCHAR NOT NULL,
+  tx_hash    VARCHAR NOT NULL,
+  tx_result  {BLOB} NOT NULL,
+  UNIQUE (block_id, tx_index)
+);
+CREATE TABLE IF NOT EXISTS events (
+  rowid    {PK},
+  block_id BIGINT NOT NULL REFERENCES blocks(rowid),
+  tx_id    BIGINT NULL REFERENCES tx_results(rowid),
+  type     VARCHAR NOT NULL
+);
+CREATE TABLE IF NOT EXISTS attributes (
+  event_id      BIGINT NOT NULL REFERENCES events(rowid),
+  key           VARCHAR NOT NULL,
+  composite_key VARCHAR NOT NULL,
+  value         VARCHAR NULL,
+  UNIQUE (event_id, key)
+);
+CREATE VIEW IF NOT EXISTS event_attributes AS
+  SELECT block_id, tx_id, type, key, composite_key, value
+  FROM events LEFT JOIN attributes ON (events.rowid = attributes.event_id);
+CREATE VIEW IF NOT EXISTS block_events AS
+  SELECT blocks.rowid as block_id, height, chain_id, type, key,
+         composite_key, value
+  FROM blocks JOIN event_attributes
+    ON (blocks.rowid = event_attributes.block_id)
+  WHERE event_attributes.tx_id IS NULL;
+CREATE VIEW IF NOT EXISTS tx_events AS
+  SELECT height, tx_index, chain_id, type, key, composite_key, value,
+         tx_results.created_at
+  FROM blocks JOIN tx_results ON (blocks.rowid = tx_results.block_id)
+  JOIN event_attributes ON (tx_results.rowid = event_attributes.tx_id)
+  WHERE event_attributes.tx_id IS NOT NULL;
+"""
+
+BLOCK_HEIGHT_KEY = "block.height"
+TX_HASH_KEY = "tx.hash"
+TX_HEIGHT_KEY = "tx.height"
+
+
+def connect(conn_str: str):
+    """Open a DB-API connection from a psql_conn-style string. ``sqlite:PATH``
+    (or a bare path / ``:memory:``) opens stdlib sqlite3; anything else is
+    handed to psycopg2 when available (the reference's driver,
+    psql.go:24 driverName)."""
+    if conn_str.startswith("sqlite:"):
+        conn_str = conn_str[len("sqlite:"):]
+    elif "=" in conn_str or conn_str.startswith("postgres"):
+        try:
+            import psycopg2  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "psql_conn looks like a postgres conn string but no "
+                "postgres driver is installed; use 'sqlite:PATH'") from e
+        return psycopg2.connect(conn_str)
+    import sqlite3
+
+    return sqlite3.connect(conn_str, check_same_thread=False)
+
+
+class SqlEventSink:
+    """reference: state/indexer/sink/psql/psql.go:30 EventSink."""
+
+    def __init__(self, conn, chain_id: str):
+        self._conn = conn
+        self.chain_id = chain_id
+        self._mtx = threading.Lock()
+        mod = type(conn).__module__.split(".")[0]
+        self._pg = mod.startswith("psycopg")
+        self._ph = "%s" if self._pg else "?"
+        self.ensure_schema()
+
+    def _sql(self, q: str) -> str:
+        return q.replace("$", self._ph)
+
+    def ensure_schema(self) -> None:
+        pk = ("BIGSERIAL PRIMARY KEY" if self._pg
+              else "INTEGER PRIMARY KEY AUTOINCREMENT")
+        blob = "BYTEA" if self._pg else "BLOB"
+        ddl = SCHEMA.format(PK=pk, BLOB=blob)
+        if self._pg:
+            ddl = ddl.replace("CREATE VIEW IF NOT EXISTS",
+                              "CREATE OR REPLACE VIEW")
+        with self._mtx:
+            cur = self._conn.cursor()
+            for stmt in ddl.split(";"):
+                if stmt.strip():
+                    cur.execute(stmt)
+            self._conn.commit()
+
+    # -- write paths (psql.go:142 IndexBlockEvents, :177 IndexTxEvents) ------
+
+    def _insert_events(self, cur, block_id: int, tx_id, events) -> None:
+        """psql.go:86 insertEvents: one row per event, one per indexed
+        attribute; empty event types skipped."""
+        for e in events or ():
+            etype = e.type
+            if not etype:
+                continue
+            cur.execute(self._sql(
+                "INSERT INTO events (block_id, tx_id, type) "
+                "VALUES ($, $, $) RETURNING rowid"), (block_id, tx_id, etype))
+            eid = cur.fetchone()[0]
+            for a in e.attributes or ():
+                if not a.index:
+                    continue
+                key = a.key.decode("utf-8", "replace")
+                cur.execute(self._sql(
+                    "INSERT INTO attributes (event_id, key, composite_key, "
+                    "value) VALUES ($, $, $, $)"),
+                    (eid, key, f"{etype}.{key}",
+                     a.value.decode("utf-8", "replace")))
+
+    def _meta_event(self, cur, block_id: int, tx_id, composite_key: str,
+                    value: str) -> None:
+        """psql.go:130 makeIndexedEvent: "type.name" becomes a single-
+        attribute event."""
+        etype, _, name = composite_key.partition(".")
+        cur.execute(self._sql(
+            "INSERT INTO events (block_id, tx_id, type) "
+            "VALUES ($, $, $) RETURNING rowid"), (block_id, tx_id, etype))
+        eid = cur.fetchone()[0]
+        if name:
+            cur.execute(self._sql(
+                "INSERT INTO attributes (event_id, key, composite_key, value) "
+                "VALUES ($, $, $, $)"), (eid, name, composite_key, value))
+
+    def _now(self) -> str:
+        return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+    def index_block_events(self, height: int, begin_events, end_events) -> None:
+        with self._mtx:
+            cur = self._conn.cursor()
+            try:
+                cur.execute(self._sql(
+                    "INSERT INTO blocks (height, chain_id, created_at) "
+                    "VALUES ($, $, $) ON CONFLICT DO NOTHING RETURNING rowid"),
+                    (height, self.chain_id, self._now()))
+                row = cur.fetchone()
+                if row is None:  # duplicate: quietly succeed (psql.go:154)
+                    self._conn.rollback()
+                    return
+                block_id = row[0]
+                self._meta_event(cur, block_id, None, BLOCK_HEIGHT_KEY,
+                                 str(height))
+                # Order matters: begin-block before end-block (psql.go:166).
+                self._insert_events(cur, block_id, None, begin_events)
+                self._insert_events(cur, block_id, None, end_events)
+                self._conn.commit()
+            except Exception:
+                self._conn.rollback()
+                raise
+
+    def index_tx(self, height: int, idx: int, tx: bytes, result) -> None:
+        from tendermint_tpu.types.tx import tx_hash
+
+        h = tx_hash(tx).hex().upper()
+        doc = _tx_result_doc(height, idx, tx, result, h)
+        with self._mtx:
+            cur = self._conn.cursor()
+            try:
+                cur.execute(self._sql(
+                    "SELECT rowid FROM blocks WHERE height = $ AND "
+                    "chain_id = $"), (height, self.chain_id))
+                row = cur.fetchone()
+                if row is None:
+                    raise ValueError(
+                        f"no indexed block at height {height}; the block "
+                        "header must be indexed before its transactions")
+                block_id = row[0]
+                cur.execute(self._sql(
+                    "INSERT INTO tx_results (block_id, tx_index, created_at, "
+                    "tx_hash, tx_result) VALUES ($, $, $, $, $) "
+                    "ON CONFLICT DO NOTHING RETURNING rowid"),
+                    (block_id, idx, self._now(), h,
+                     json.dumps(doc).encode()))
+                row = cur.fetchone()
+                if row is None:  # duplicate: quietly succeed (psql.go:207)
+                    self._conn.rollback()
+                    return
+                tx_id = row[0]
+                self._meta_event(cur, block_id, tx_id, TX_HASH_KEY, h)
+                self._meta_event(cur, block_id, tx_id, TX_HEIGHT_KEY,
+                                 str(height))
+                self._insert_events(cur, block_id, tx_id,
+                                    result.events if result else ())
+                self._conn.commit()
+            except Exception:
+                self._conn.rollback()
+                raise
+
+    def stop(self) -> None:
+        with self._mtx:  # wait out any in-flight index transaction
+            self._conn.close()
+
+    # -- backport adapters (backport.go:32,65) -------------------------------
+
+    def tx_indexer(self) -> "BackportTxIndexer":
+        return BackportTxIndexer(self)
+
+    def block_indexer(self) -> "BackportBlockIndexer":
+        return BackportBlockIndexer(self)
+
+
+def _tx_result_doc(height: int, idx: int, tx: bytes, result,
+                   hash_hex: str) -> dict:
+    """Same JSON document shape the kv indexer stores (state/txindex.py)."""
+    import base64
+
+    return {
+        "hash": hash_hex,
+        "height": str(height),
+        "index": idx,
+        "tx": base64.b64encode(tx).decode(),
+        "tx_result": {
+            "code": result.code if result else 0,
+            "data": base64.b64encode(result.data if result else b"").decode(),
+            "log": result.log if result else "",
+            "gas_wanted": str(result.gas_wanted if result else 0),
+            "gas_used": str(result.gas_used if result else 0),
+            "events": [
+                {"type": e.type, "attributes": [
+                    {"key": base64.b64encode(a.key).decode(),
+                     "value": base64.b64encode(a.value).decode(),
+                     "index": a.index}
+                    for a in e.attributes]}
+                for e in (result.events if result else [])
+            ],
+        },
+    }
+
+
+class BackportTxIndexer:
+    """Bridges the sink to the TxIndexer interface IndexerService drives;
+    reads are not supported by this sink (backport.go:38-61)."""
+
+    def __init__(self, sink: SqlEventSink):
+        self._sink = sink
+
+    def index(self, height: int, idx: int, tx: bytes, result) -> None:
+        self._sink.index_tx(height, idx, tx, result)
+
+    def get(self, h: bytes):
+        raise ValueError("the TxIndexer.Get method is not supported by the "
+                         "sql event sink")
+
+    def search(self, query: str):
+        raise ValueError("the TxIndexer.Search method is not supported by "
+                         "the sql event sink")
+
+
+class BackportBlockIndexer:
+    """backport.go:70 BackportBlockIndexer."""
+
+    def __init__(self, sink: SqlEventSink):
+        self._sink = sink
+
+    def index(self, height: int, begin_block_events, end_block_events) -> None:
+        self._sink.index_block_events(height, begin_block_events,
+                                      end_block_events)
+
+    def has(self, height: int) -> bool:
+        raise ValueError("the BlockIndexer.Has method is not supported by "
+                         "the sql event sink")
+
+    def search(self, query: str):
+        raise ValueError("the BlockIndexer.Search method is not supported by "
+                         "the sql event sink")

@@ -11,9 +11,8 @@ Search is the AND of per-condition posting scans: `=` conditions hit exact
 posting prefixes; range/CONTAINS/EXISTS conditions scan the key's postings
 and filter values (full reference operator grammar,
 libs/pubsub/query/query.go). The reference's psql sink
-(state/indexer/sink/psql) has no analogue here: this image ships no
-postgres driver, and the kv sink is the one the reference enables by
-default.
+(state/indexer/sink/psql) is mirrored by state/sql_sink.py (write-only,
+any DB-API driver; tested on sqlite3).
 """
 
 from __future__ import annotations
@@ -213,6 +212,10 @@ class IndexerService:
             self.event_bus.unsubscribe_all(self.SUBSCRIBER)
         except ValueError:
             pass
+        # Join the drain thread so no index write is in flight when callers
+        # (e.g. Node.stop) go on to close the sink's DB connection.
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=2.0)
 
     def _run(self) -> None:
         try:
@@ -221,23 +224,32 @@ class IndexerService:
             return  # unsubscribed during stop()
 
     def _drain(self) -> None:
+        # Reference ordering (state/txindex/indexer_service.go:59-75): drive
+        # off the header subscription; for each header pull exactly num_txs
+        # tx events, index the BLOCK first, then its txs — the SQL sink
+        # requires the block row to exist before its tx rows.
         while self._running:
-            msg = self._tx_sub.next(timeout=0.1)
-            if msg is not None:
-                d = msg.data
+            bmsg = self._block_sub.next(timeout=0.1)
+            if bmsg is None:
+                continue
+            d = bmsg.data
+            try:
+                self.block_indexer.index(
+                    d.header.height,
+                    d.result_begin_block.events if d.result_begin_block else [],
+                    d.result_end_block.events if d.result_end_block else [])
+            except Exception as e:  # noqa: BLE001
+                if self.logger:
+                    self.logger.error("failed to index block", err=e)
+            for _ in range(d.num_txs):
+                msg = None
+                while self._running and msg is None:
+                    msg = self._tx_sub.next(timeout=0.1)
+                if msg is None:
+                    return
+                t = msg.data
                 try:
-                    self.tx_indexer.index(d.height, d.index, d.tx, d.result)
+                    self.tx_indexer.index(t.height, t.index, t.tx, t.result)
                 except Exception as e:  # noqa: BLE001
                     if self.logger:
                         self.logger.error("failed to index tx", err=e)
-            bmsg = self._block_sub.next(timeout=0.05)
-            if bmsg is not None:
-                d = bmsg.data
-                try:
-                    self.block_indexer.index(
-                        d.header.height,
-                        d.result_begin_block.events if d.result_begin_block else [],
-                        d.result_end_block.events if d.result_end_block else [])
-                except Exception as e:  # noqa: BLE001
-                    if self.logger:
-                        self.logger.error("failed to index block", err=e)

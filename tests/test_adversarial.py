@@ -7,7 +7,7 @@ import os
 import socket
 import time
 
-from tendermint_tpu.config.config import test_config
+from tendermint_tpu.config.config import test_config as make_test_config
 from tendermint_tpu.consensus.misbehavior import double_prevote
 from tendermint_tpu.crypto import ed25519
 from tendermint_tpu.node.node import Node
@@ -37,7 +37,7 @@ def _mk_net(tmp_path, n):
     )
     nodes = []
     for i in range(n):
-        cfg = test_config()
+        cfg = make_test_config()
         cfg.set_root(str(tmp_path / f"n{i}"))
         os.makedirs(cfg.base.root_dir, exist_ok=True)
         cfg.base.fast_sync_mode = False

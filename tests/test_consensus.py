@@ -10,7 +10,7 @@ import time
 import pytest
 
 from tendermint_tpu.abci.kvstore import KVStoreApplication
-from tendermint_tpu.config.config import test_config
+from tendermint_tpu.config.config import test_config as make_test_config
 from tendermint_tpu.consensus.state_machine import (
     BlockPartMessage,
     ConsensusState,
@@ -57,7 +57,7 @@ def make_net(n, wal_base=None):
         genesis_time=Time(1700001000, 0),
         validators=[GenesisValidator(b"", p.pub_key(), 10) for p in privs],
     )
-    cfg = test_config()
+    cfg = make_test_config()
     nodes = [
         Node(genesis, pvs[i], cfg,
              wal_dir=os.path.join(wal_base, f"wal{i}") if wal_base else None)

@@ -1,0 +1,61 @@
+"""Counter example app vs reference abci/example/counter/counter.go."""
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.counter import (
+    CODE_TYPE_BAD_NONCE,
+    CODE_TYPE_ENCODING_ERROR,
+    CounterApp,
+)
+
+
+def _tx(n: int) -> bytes:
+    return n.to_bytes((n.bit_length() + 7) // 8 or 1, "big")
+
+
+def test_non_serial_accepts_anything():
+    app = CounterApp()
+    assert app.check_tx(abci.RequestCheckTx(tx=b"\x00" * 20)).is_ok()
+    assert app.deliver_tx(abci.RequestDeliverTx(tx=b"whatever")).is_ok()
+    assert app.tx_count == 1
+
+
+def test_serial_nonce_rules():
+    app = CounterApp(serial=True)
+    # CheckTx: >= count passes, < count is a bad nonce (counter.go:66-82)
+    assert app.check_tx(abci.RequestCheckTx(tx=_tx(0))).is_ok()
+    assert app.check_tx(abci.RequestCheckTx(tx=_tx(5))).is_ok()
+    # DeliverTx: must equal the count exactly (counter.go:45-62)
+    assert app.deliver_tx(abci.RequestDeliverTx(tx=_tx(0))).is_ok()
+    r = app.deliver_tx(abci.RequestDeliverTx(tx=_tx(0)))
+    assert r.code == CODE_TYPE_BAD_NONCE and "Expected 1" in r.log
+    assert app.deliver_tx(abci.RequestDeliverTx(tx=_tx(1))).is_ok()
+    r = app.check_tx(abci.RequestCheckTx(tx=_tx(1)))
+    assert r.code == CODE_TYPE_BAD_NONCE
+    # oversize tx
+    r = app.deliver_tx(abci.RequestDeliverTx(tx=b"\x01" * 9))
+    assert r.code == CODE_TYPE_ENCODING_ERROR
+
+
+def test_commit_hash_and_query():
+    app = CounterApp()
+    assert app.commit().data == b""  # no txs yet: empty hash (counter.go:87)
+    app.deliver_tx(abci.RequestDeliverTx(tx=b"\x00"))
+    # tx_count is 1 after one deliver; the hash is its 8-byte BE encoding
+    assert app.commit().data == (1).to_bytes(8, "big")
+    assert app.query(abci.RequestQuery(path="hash")).value == b"2"
+    assert app.query(abci.RequestQuery(path="tx")).value == b"1"
+    assert "Invalid query path" in app.query(abci.RequestQuery(path="x")).log
+
+
+def test_set_option_enables_serial():
+    app = CounterApp()
+    app.set_option("serial", "on")
+    app.deliver_tx(abci.RequestDeliverTx(tx=_tx(0)))
+    assert app.deliver_tx(abci.RequestDeliverTx(tx=_tx(7))).code == CODE_TYPE_BAD_NONCE
+
+
+def test_counter_in_node_selection():
+    from tendermint_tpu.node.node import default_app
+
+    assert isinstance(default_app("counter"), CounterApp)
+    assert default_app("counter_serial").serial is True

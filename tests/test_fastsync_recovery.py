@@ -10,7 +10,7 @@ import time
 
 import pytest
 
-from tendermint_tpu.config.config import test_config
+from tendermint_tpu.config.config import test_config as make_test_config
 from tendermint_tpu.crypto import ed25519
 from tendermint_tpu.node.node import Node
 from tendermint_tpu.p2p.key import NodeKey
@@ -30,7 +30,7 @@ def _wait(cond, timeout, interval=0.1):
 
 def _mk_node(tmp_path, name, genesis, priv=None, fast_sync=False,
              persistent_peers=""):
-    cfg = test_config()
+    cfg = make_test_config()
     cfg.set_root(str(tmp_path / name))
     os.makedirs(cfg.base.root_dir, exist_ok=True)
     cfg.base.fast_sync_mode = fast_sync
@@ -155,7 +155,7 @@ def test_fastsync_v1_cold_node_catches_up(tmp_path):
         assert n1.switch.dial_peer(n0.p2p_addr()) is not None
         assert _wait(lambda: n0.block_store.height >= 22, 90), n0.block_store.height
 
-        cfg = test_config()
+        cfg = make_test_config()
         cfg.set_root(str(tmp_path / "late-v1"))
         os.makedirs(cfg.base.root_dir, exist_ok=True)
         cfg.base.fast_sync_mode = True
@@ -202,7 +202,7 @@ def test_fastsync_v2_cold_node_catches_up(tmp_path):
         assert n1.switch.dial_peer(n0.p2p_addr()) is not None
         assert _wait(lambda: n0.block_store.height >= 22, 90), n0.block_store.height
 
-        cfg = test_config()
+        cfg = make_test_config()
         cfg.set_root(str(tmp_path / "late-v2"))
         os.makedirs(cfg.base.root_dir, exist_ok=True)
         cfg.base.fast_sync_mode = True

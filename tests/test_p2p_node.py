@@ -6,7 +6,7 @@ import time
 
 import pytest
 
-from tendermint_tpu.config.config import test_config
+from tendermint_tpu.config.config import test_config as make_test_config
 from tendermint_tpu.crypto import ed25519
 from tendermint_tpu.node.node import Node
 from tendermint_tpu.p2p.key import NodeKey
@@ -26,7 +26,7 @@ def _mk_genesis(n):
 
 
 def _mk_node(tmp_path, i, genesis, priv, fast_sync=False):
-    cfg = test_config()
+    cfg = make_test_config()
     cfg.set_root(str(tmp_path / f"node{i}"))
     os.makedirs(cfg.base.root_dir, exist_ok=True)
     cfg.base.fast_sync_mode = fast_sync

@@ -6,7 +6,7 @@ import os
 import time
 import urllib.request
 
-from tendermint_tpu.config.config import test_config
+from tendermint_tpu.config.config import test_config as make_test_config
 from tendermint_tpu.crypto import ed25519
 from tendermint_tpu.node.node import Node
 from tendermint_tpu.p2p.key import NodeKey
@@ -21,7 +21,7 @@ def _mk_node(tmp_path):
         chain_id="rpc-chain", genesis_time=Time(1700003000, 0),
         validators=[GenesisValidator(b"", priv.pub_key(), 10)],
     )
-    cfg = test_config()
+    cfg = make_test_config()
     cfg.set_root(str(tmp_path / "node"))
     os.makedirs(cfg.base.root_dir, exist_ok=True)
     cfg.base.fast_sync_mode = False

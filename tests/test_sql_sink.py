@@ -1,0 +1,187 @@
+"""SQL event sink (state/sql_sink.py) vs the reference psql sink semantics
+(state/indexer/sink/psql/{psql.go,backport.go})."""
+
+import json
+import sqlite3
+
+import pytest
+
+from tendermint_tpu.abci.types import Event, EventAttribute, ResponseDeliverTx
+from tendermint_tpu.state.sql_sink import SqlEventSink, connect
+from tendermint_tpu.types.tx import tx_hash
+
+def _sink():
+    return SqlEventSink(sqlite3.connect(":memory:"), "test-chain")
+
+
+def _ev(etype, **attrs):
+    return Event(type=etype, attributes=[
+        EventAttribute(key=k.encode(), value=v.encode(), index=True)
+        for k, v in attrs.items()])
+
+
+def test_block_events_rows_and_views():
+    s = _sink()
+    s.index_block_events(5, [_ev("begin", phase="b")], [_ev("end", phase="e")])
+    cur = s._conn.cursor()
+    cur.execute("SELECT height, chain_id FROM blocks")
+    assert cur.fetchall() == [(5, "test-chain")]
+    # block_events view: the block.height meta-event plus both app events,
+    # all with tx_id NULL (psql.go:161-171).
+    cur.execute("SELECT type, composite_key, value FROM block_events")
+    rows = set(cur.fetchall())
+    assert ("block", "block.height", "5") in rows
+    assert ("begin", "begin.phase", "b") in rows
+    assert ("end", "end.phase", "e") in rows
+
+
+def test_duplicate_block_quietly_succeeds():
+    s = _sink()
+    s.index_block_events(5, [], [])
+    s.index_block_events(5, [_ev("x", a="1")], [])  # duplicate: no-op
+    cur = s._conn.cursor()
+    cur.execute("SELECT COUNT(*) FROM blocks")
+    assert cur.fetchone()[0] == 1
+    cur.execute("SELECT COUNT(*) FROM events")
+    assert cur.fetchone()[0] == 1  # only the first insert's meta-event
+
+
+def test_tx_events_and_meta_rows():
+    s = _sink()
+    s.index_block_events(7, [], [])
+    res = ResponseDeliverTx(code=0, events=[_ev("transfer", amount="10")])
+    s.index_tx(7, 0, b"tx-bytes", res)
+    cur = s._conn.cursor()
+    cur.execute("SELECT tx_hash, tx_result FROM tx_results")
+    h, raw = cur.fetchone()
+    assert h == tx_hash(b"tx-bytes").hex().upper()
+    doc = json.loads(raw)
+    assert doc["height"] == "7"
+    assert doc["tx_result"]["events"][0]["type"] == "transfer"
+    # tx_events view carries the hash/height meta-events + app event
+    # (psql.go:214-222).
+    cur.execute("SELECT composite_key, value FROM tx_events")
+    rows = set(cur.fetchall())
+    assert ("tx.hash", h) in rows
+    assert ("tx.height", "7") in rows
+    assert ("transfer.amount", "10") in rows
+
+
+def test_tx_before_block_errors():
+    s = _sink()
+    with pytest.raises(ValueError, match="must be indexed before"):
+        s.index_tx(3, 0, b"t", ResponseDeliverTx())
+
+
+def test_duplicate_tx_quietly_succeeds():
+    s = _sink()
+    s.index_block_events(7, [], [])
+    s.index_tx(7, 0, b"t", ResponseDeliverTx())
+    s.index_tx(7, 0, b"t", ResponseDeliverTx())
+    cur = s._conn.cursor()
+    cur.execute("SELECT COUNT(*) FROM tx_results")
+    assert cur.fetchone()[0] == 1
+
+
+def test_unindexed_attributes_and_empty_types_skipped():
+    s = _sink()
+    ev = Event(type="t", attributes=[
+        EventAttribute(key=b"k", value=b"v", index=False)])
+    s.index_block_events(1, [ev, Event(type="")], [])
+    cur = s._conn.cursor()
+    cur.execute("SELECT COUNT(*) FROM attributes WHERE composite_key='t.k'")
+    assert cur.fetchone()[0] == 0
+    cur.execute("SELECT COUNT(*) FROM events WHERE type=''")
+    assert cur.fetchone()[0] == 0
+
+
+def test_backport_adapters_write_only():
+    s = _sink()
+    txi, bli = s.tx_indexer(), s.block_indexer()
+    s.index_block_events(2, [], [])
+    txi.index(2, 0, b"via-adapter", ResponseDeliverTx())
+    bli.index(2, [], [])  # duplicate block: quiet no-op through the adapter
+    for fn in (lambda: txi.get(b"\x00"), lambda: txi.search("tx.height=2"),
+               lambda: bli.has(2), lambda: bli.search("block.height=2")):
+        with pytest.raises(ValueError, match="not supported"):
+            fn()
+
+
+def test_connect_sqlite_scheme(tmp_path):
+    conn = connect(f"sqlite:{tmp_path}/sink.db")
+    s = SqlEventSink(conn, "c")
+    s.index_block_events(1, [], [])
+    s.stop()
+    # reopen: schema + row persisted
+    conn2 = connect(f"sqlite:{tmp_path}/sink.db")
+    s2 = SqlEventSink(conn2, "c")
+    cur = s2._conn.cursor()
+    cur.execute("SELECT height FROM blocks")
+    assert cur.fetchone() == (1,)
+    s2.stop()
+
+
+def _psql_node(tmp_path, conn_str):
+    import os
+
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.privval.file_pv import MockPV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types.ttime import Time
+
+    priv = ed25519.gen_priv_key(b"\x93" * 32)
+    genesis = GenesisDoc(
+        chain_id="sink-chain", genesis_time=Time(1700004000, 0),
+        validators=[GenesisValidator(b"", priv.pub_key(), 10)],
+    )
+    cfg = test_config()
+    cfg.set_root(str(tmp_path / "node"))
+    os.makedirs(cfg.base.root_dir, exist_ok=True)
+    cfg.base.fast_sync_mode = False
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.consensus.wal_path = ""
+    cfg.tx_index.indexer = "psql"
+    cfg.tx_index.psql_conn = conn_str
+    return Node(cfg, genesis=genesis, priv_validator=MockPV(priv),
+                node_key=NodeKey(ed25519.gen_priv_key(b"\x94" * 32))), priv
+
+
+def test_node_with_sql_sink(tmp_path):
+    """A live node on the psql indexer writes blocks+txs to the SQL store
+    and serves 'not supported' for search RPCs (reference:
+    node/node.go:282-299 + backport.go)."""
+    import time as _time
+
+    db_path = tmp_path / "sink.db"
+    node, _ = _psql_node(tmp_path, f"sqlite:{db_path}")
+    node.start()
+    try:
+        node.mempool.check_tx(b"sunk=yes")
+        h = tx_hash(b"sunk=yes").hex().upper()
+        reader = sqlite3.connect(db_path)
+        deadline = _time.monotonic() + 60
+        row = None
+        while _time.monotonic() < deadline and row is None:
+            row = reader.execute(
+                "SELECT tx_hash FROM tx_results WHERE tx_hash=?",
+                (h,)).fetchone()
+            _time.sleep(0.1)
+        assert row == (h,)
+        assert reader.execute("SELECT COUNT(*) FROM blocks").fetchone()[0] >= 1
+        metas = set(reader.execute(
+            "SELECT composite_key FROM tx_events").fetchall())
+        assert ("tx.hash",) in metas and ("tx.height",) in metas
+        with pytest.raises(ValueError, match="not supported"):
+            node.tx_indexer.search("tx.height>0")
+    finally:
+        node.stop()
+
+
+def test_node_wiring_requires_conn_string(tmp_path):
+    """reference: node/node.go:284 errors when PsqlConn is empty."""
+    with pytest.raises(ValueError, match="psql_conn"):
+        _psql_node(tmp_path, "")
