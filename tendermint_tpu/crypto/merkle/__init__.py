@@ -62,33 +62,51 @@ def hash_from_byte_slices(items: list[bytes]) -> bytes:
 
 
 def _hash_from_byte_slices_batched(items: list[bytes]) -> bytes:
-    """Level-order batched evaluation of the RFC-6962 tree.
+    """Level-order batched evaluation of the RFC-6962 tree: the t=1 case
+    of hash_trees_fixed (one shared copy of the pairing loop)."""
+    return hash_trees_fixed([items])[0]
 
-    The reference shape (split at the largest power of two < n,
-    crypto/merkle/tree.go getSplitPoint) is identical to repeatedly pairing
-    adjacent nodes left-to-right and promoting a trailing odd node unchanged,
-    so every level is one fixed-width SHA-256 batch through csrc/hash_batch.c
-    (sha256_batch_fixed) instead of n-1 hashlib calls.
-    """
+
+def hash_trees_fixed(trees: list[list[bytes]]) -> list[bytes]:
+    """Roots of T same-arity RFC-6962 trees in O(log n) C-batched calls.
+
+    The reference split rule (largest power of two < n,
+    crypto/merkle/tree.go getSplitPoint) equals repeatedly pairing adjacent
+    nodes left-to-right and promoting a trailing odd node unchanged; every
+    tree has the same level structure, so all T trees advance one level per
+    sha256 batch. Used to hash header CHAINS (each header = a fixed
+    14-field tree, types/block.go:440-476) where per-tree batching never
+    kicks in; the single-tree batched path is the t=1 case."""
     import numpy as np
 
     from tendermint_tpu.ops import chash
 
-    level = chash.sha256_many([LEAF_PREFIX + it for it in items])
-    prefix = np.frombuffer(INNER_PREFIX, dtype=np.uint8)
-    while len(level) > 1:
-        n = len(level)
+    t = len(trees)
+    if t == 0:
+        return []
+    n = len(trees[0])
+    if any(len(tr) != n for tr in trees):
+        raise ValueError("hash_trees_fixed requires same-arity trees")
+    if n == 0:
+        return [empty_hash()] * t
+    flat = [LEAF_PREFIX + it for tr in trees for it in tr]
+    level = chash.sha256_many(flat).reshape(t, n, 32)
+    prefix = INNER_PREFIX[0]
+    while level.shape[1] > 1:
+        n = level.shape[1]
         pairs = n // 2
-        rows = np.empty((pairs, 65), dtype=np.uint8)
-        rows[:, 0] = prefix[0]
-        rows[:, 1:33] = level[0 : 2 * pairs : 2]
-        rows[:, 33:65] = level[1 : 2 * pairs : 2]
-        hashed = chash.sha256_fixed(rows)
+        rows = np.empty((t, pairs, 65), dtype=np.uint8)
+        rows[:, :, 0] = prefix
+        rows[:, :, 1:33] = level[:, 0:2 * pairs:2]
+        rows[:, :, 33:65] = level[:, 1:2 * pairs:2]
+        hashed = chash.sha256_fixed(
+            np.ascontiguousarray(rows.reshape(t * pairs, 65))
+        ).reshape(t, pairs, 32)
         if n % 2:
-            level = np.concatenate([hashed, level[n - 1 :]], axis=0)
+            level = np.concatenate([hashed, level[:, n - 1:]], axis=1)
         else:
             level = hashed
-    return level[0].tobytes()
+    return [level[i, 0].tobytes() for i in range(t)]
 
 
 @dataclass

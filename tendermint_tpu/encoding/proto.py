@@ -24,7 +24,12 @@ WIRE_BYTES = 2
 WIRE_FIXED32 = 5
 
 
+_UV1 = tuple(bytes((i,)) for i in range(0x80))
+
+
 def encode_uvarint(n: int) -> bytes:
+    if 0 <= n < 0x80:  # single-byte fast path (tags, lengths, small ints)
+        return _UV1[n]
     if n < 0:
         raise ValueError("uvarint cannot be negative")
     out = bytearray()
@@ -70,8 +75,15 @@ def decode_varint(buf: bytes, pos: int = 0) -> tuple[int, int]:
     return v, pos
 
 
+_TAG_CACHE: dict[int, bytes] = {}
+
+
 def tag(field: int, wire: int) -> bytes:
-    return encode_uvarint(field << 3 | wire)
+    key = field << 3 | wire
+    t = _TAG_CACHE.get(key)
+    if t is None:
+        t = _TAG_CACHE[key] = encode_uvarint(key)
+    return t
 
 
 class Writer:

@@ -49,6 +49,13 @@ def verify_header_range(trusted: LightBlock, chain: list[LightBlock],
     """
     if not chain:
         return
+    # Hash every header in the range as one batched merkle forest before
+    # the serial replay walks them (types/block.py precompute_header_hashes).
+    from tendermint_tpu.types.block import precompute_header_hashes
+
+    precompute_header_hashes(
+        [lb.signed_header.header for lb in chain
+         if lb.signed_header and lb.signed_header.header])
     # Phase 1: host-side structural checks + signature collection.
     verifier = crypto_batch.create_batch_verifier()
     plan = []  # (lb, prefix, needed)

@@ -28,15 +28,21 @@ BLOCK_PROTOCOL = 11  # reference: version/version.go:21
 
 
 def cdc_encode_string(v: str) -> bytes:
-    return proto.Writer().string(1, v).out() if v else b""
+    return cdc_encode_bytes(v.encode("utf-8")) if v else b""
 
 
 def cdc_encode_int64(v: int) -> bytes:
-    return proto.Writer().varint(1, v).out() if v else b""
+    if not v:
+        return b""
+    return b"\x08" + proto.encode_varint(v)  # field 1, wire varint
 
 
 def cdc_encode_bytes(v: bytes) -> bytes:
-    return proto.Writer().bytes(1, v).out() if v else b""
+    if not v:
+        return b""
+    if len(v) < 0x80:  # field 1, wire bytes, single-byte length
+        return b"\x0a" + bytes((len(v),)) + v
+    return proto.Writer().bytes(1, v).out()
 
 
 @dataclass(frozen=True)
@@ -71,13 +77,14 @@ class Header:
     last_results_hash: bytes = b""
     evidence_hash: bytes = b""
     proposer_address: bytes = b""
+    # Set only by precompute_header_hashes on finished headers.
+    _hash_cache: bytes | None = dc_field(
+        default=None, repr=False, compare=False)
 
-    def hash(self) -> bytes | None:
-        """reference: types/block.go:440-476. None when ValidatorsHash is
-        unset (header not yet complete)."""
-        if not self.validators_hash:
-            return None
-        return merkle.hash_from_byte_slices([
+    def hash_fields(self) -> list[bytes]:
+        """The 14 merkle leaves of the header hash
+        (reference: types/block.go:440-476)."""
+        return [
             self.version.marshal(),
             cdc_encode_string(self.chain_id),
             cdc_encode_int64(self.height),
@@ -92,7 +99,18 @@ class Header:
             cdc_encode_bytes(self.last_results_hash),
             cdc_encode_bytes(self.evidence_hash),
             cdc_encode_bytes(self.proposer_address),
-        ])
+        ]
+
+    def hash(self) -> bytes | None:
+        """reference: types/block.go:440-476. None when ValidatorsHash is
+        unset (header not yet complete). Headers may be filled in
+        incrementally, so the hash is NOT cached here — batch paths that
+        hold finished headers use precompute_header_hashes."""
+        if not self.validators_hash:
+            return None
+        if self._hash_cache is not None:
+            return self._hash_cache
+        return merkle.hash_from_byte_slices(self.hash_fields())
 
     def validate_basic(self) -> None:
         if len(self.chain_id) > 50:
@@ -150,6 +168,21 @@ class Header:
             evidence_hash=f.get(13, [b""])[-1],
             proposer_address=f.get(14, [b""])[-1],
         )
+
+
+def precompute_header_hashes(headers: list[Header]) -> None:
+    """Hash a whole header chain as one same-arity merkle forest
+    (crypto/merkle hash_trees_fixed: O(log 14) C-batched sha256 calls
+    instead of 27 hashlib calls per header) and fill each header's hash
+    cache. Only finished headers (validators_hash set) are cached; call
+    this on received chains, never on headers still being built."""
+    done = [h for h in headers
+            if h.validators_hash and h._hash_cache is None]
+    if not done:
+        return
+    roots = merkle.hash_trees_fixed([h.hash_fields() for h in done])
+    for h, root in zip(done, roots):
+        h._hash_cache = root
 
 
 @dataclass
@@ -244,7 +277,37 @@ class Commit:
         )
 
     def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
-        return self.get_vote(val_idx).sign_bytes(chain_id)
+        """Canonical sign bytes for the precommit in slot val_idx —
+        equivalent to get_vote(val_idx).sign_bytes(chain_id) (differential-
+        tested). All fields except the per-signature timestamp are constant
+        across a commit (height/round/block_id never mutate after
+        construction), so the constant prefix/suffix per block-id flag is
+        templated once and the timestamp spliced in; verify_commit-style
+        loops pay one Writer build per commit instead of one per vote."""
+        from tendermint_tpu.types.vote import canonical_block_id_bytes
+
+        cache = getattr(self, "_sb_cache", None)
+        if cache is None or cache[0] != chain_id:
+            cache = (chain_id, {})
+            self._sb_cache = cache
+        cs = self.signatures[val_idx]
+        tmpl = cache[1].get(cs.block_id_flag)
+        if tmpl is None:
+            w = proto.Writer()
+            w.varint(1, PRECOMMIT_TYPE)
+            w.sfixed64(2, self.height)
+            w.sfixed64(3, self.round)
+            cbid = canonical_block_id_bytes(cs.block_id(self.block_id))
+            if cbid is not None:
+                w.message(4, cbid, always=True)
+            suffix = proto.Writer().string(6, chain_id).out()
+            tmpl = (w.out(), suffix)
+            cache[1][cs.block_id_flag] = tmpl
+        pre, suf = tmpl
+        tsm = cs.timestamp.marshal()
+        # field 5 (timestamp), wire type 2: tag 0x2a; always emitted.
+        body = pre + b"\x2a" + proto.encode_uvarint(len(tsm)) + tsm + suf
+        return proto.delimited(body)
 
     def size(self) -> int:
         return len(self.signatures)

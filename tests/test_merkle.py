@@ -102,3 +102,47 @@ def test_batched_without_c_lib(monkeypatch):
 
     items = [b"x%d" % i for i in range(100)]
     assert m._hash_from_byte_slices_batched(items) == _recursive_root(m, items)
+
+
+def test_hash_trees_fixed_matches_scalar():
+    import tendermint_tpu.crypto.merkle as m
+
+    for arity in (1, 2, 3, 7, 14, 16):
+        trees = [[b"t%d-i%d" % (t, i) for i in range(arity)]
+                 for t in range(9)]
+        roots = m.hash_trees_fixed(trees)
+        assert roots == [m.hash_from_byte_slices(tr) for tr in trees]
+    assert m.hash_trees_fixed([]) == []
+    assert m.hash_trees_fixed([[], []]) == [m.empty_hash()] * 2
+
+
+def test_hash_trees_fixed_rejects_ragged():
+    import pytest
+
+    import tendermint_tpu.crypto.merkle as m
+
+    with pytest.raises(ValueError, match="same-arity"):
+        m.hash_trees_fixed([[b"a"], [b"a", b"b"]])
+
+
+def test_precompute_header_hashes_differential():
+    from tendermint_tpu.types.block import Header, precompute_header_hashes
+    from tendermint_tpu.types.block_id import BlockID
+    from tendermint_tpu.types.ttime import Time
+
+    headers = [
+        Header(chain_id="c%d" % (i % 3), height=i + 1,
+               time=Time(1700000000 + i, i * 7),
+               last_block_id=BlockID(),
+               validators_hash=bytes([i % 251 + 1]) * 32,
+               next_validators_hash=b"\x02" * 32,
+               app_hash=b"" if i % 2 else b"\x03" * 32,
+               proposer_address=bytes([i % 200]) * 20)
+        for i in range(25)
+    ]
+    scalar = [h.hash() for h in headers]  # cache is empty: scalar path
+    incomplete = Header(chain_id="c", height=99)  # no validators_hash
+    precompute_header_hashes(headers + [incomplete])
+    assert [h.hash() for h in headers] == scalar
+    assert all(h._hash_cache is not None for h in headers)
+    assert incomplete._hash_cache is None and incomplete.hash() is None

@@ -303,3 +303,30 @@ def test_validator_set_update_and_hash():
     # removal via power 0
     vs.update_with_change_set([Validator.new(newp.pub_key(), 0)])
     assert vs.size() == 3
+
+
+def test_commit_vote_sign_bytes_template_differential():
+    """The templated Commit.vote_sign_bytes must equal building each Vote
+    (types/block.py vote_sign_bytes fast path)."""
+    from tendermint_tpu.types.block import Commit, CommitSig
+    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+    from tendermint_tpu.types.ttime import Time
+    from tendermint_tpu.types.vote import (
+        BLOCK_ID_FLAG_ABSENT,
+        BLOCK_ID_FLAG_COMMIT,
+        BLOCK_ID_FLAG_NIL,
+    )
+
+    bid = BlockID(hash=b"\x11" * 32,
+                  part_set_header=PartSetHeader(total=3, hash=b"\x22" * 32))
+    sigs = [
+        CommitSig(BLOCK_ID_FLAG_COMMIT, b"\x01" * 20, Time(1700000001, 7), b"s" * 64),
+        CommitSig(BLOCK_ID_FLAG_NIL, b"\x02" * 20, Time(1700000002, 0), b"t" * 64),
+        CommitSig(BLOCK_ID_FLAG_COMMIT, b"\x03" * 20, Time(0, 0), b"u" * 64),
+        CommitSig(BLOCK_ID_FLAG_ABSENT, b"", Time(0, 0), b""),
+    ]
+    c = Commit(height=300, round=2, block_id=bid, signatures=sigs)
+    for chain_id in ("chain-x", "other"):  # second id must drop the template
+        for i in range(len(sigs)):
+            assert (c.vote_sign_bytes(chain_id, i)
+                    == c.get_vote(i).sign_bytes(chain_id)), (chain_id, i)
