@@ -1,0 +1,310 @@
+"""Executable bounded model of the Tendermint consensus voting rules.
+
+The reference ships mechanized safety proofs (spec/ivy-proofs/
+accountable_safety_1.ivy, checked by Ivy). This module is the executable
+analogue for THIS implementation: a small-scope model of the vote/lock
+discipline written as pure functions, plus an exhaustive asynchronous
+scheduler that explores EVERY reachable interleaving at a bounded scope
+(N validators, R rounds, two candidate values) and checks:
+
+ * agreement   — no two honest validators decide different values
+               (spec/consensus.md "Theorem (no two commits)");
+ * teeth       — with the lock rule deliberately removed, or with f >= N/3,
+               the checker FINDS a disagreement trace (the invariant is
+               not vacuous);
+ * accountability — in every fork trace found at f >= N/3, blame
+               localizes: at least f+1 validators signed provably
+               contradictory votes, and every blamed validator is
+               actually byzantine (spec/consensus.md "Accountability").
+
+The model covers Algorithm 1 of the Tendermint paper at the granularity
+the safety argument needs: proposals with POL rounds, prevote/precommit
+thresholds, lock/unlock via later-round polkas, nil votes and round
+skipping. Timeouts are modeled as always-enabled nil paths (asynchrony =
+the scheduler may fire them whenever their guard holds). Byzantine
+validators "flood": every possible vote of theirs exists in the message
+soup from the start — the worst case, and it removes adversary choice
+from the search. Asynchrony is the honest validators' nondeterministic
+choice of which enabled rule to fire next; the soup is monotone, so
+exploring all rule interleavings covers all delivery schedules.
+
+Code mapping: the modeled rules are the ones consensus/state_machine.py
+implements — _do_prevote's lock check, _enter_precommit's polka handling
+(lock set/move/unlock), _is_proposal_complete's pol_round evidence check,
+and VoteSet 2/3 thresholds (types/vote_set.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+NIL = "-"
+VALUES = ("A", "B")
+
+PROPOSE, PREVOTE_STEP, PRECOMMIT_STEP, DONE = "P", "V", "C", "D"
+
+
+@dataclass(frozen=True)
+class Vote:
+    """A signed vote statement: (round, type, value, voter)."""
+
+    round: int
+    type: str  # "prevote" | "precommit"
+    value: str  # "A" | "B" | NIL
+    voter: int
+
+
+@dataclass(frozen=True)
+class Proposal:
+    round: int
+    value: str
+    pol_round: int  # -1 = fresh proposal
+
+
+@dataclass(frozen=True)
+class HonestState:
+    round: int = 0
+    step: str = PROPOSE
+    locked_value: str = NIL
+    locked_round: int = -1
+    # valid_value/valid_round are not modeled: their only consumer is the
+    # proposer's choice, and the proposal space already contains every
+    # (value, pol_round) a proposer could send (see proposals()).
+    decided: str = NIL
+
+
+@dataclass
+class Config:
+    n_honest: int = 3
+    n_byz: int = 1
+    max_round: int = 1  # rounds 0..max_round inclusive
+    lock_rule: bool = True  # teeth: set False to break R4/R5
+    quorum: int | None = None  # default = the reference's >2/3 rule
+
+    def __post_init__(self):
+        n = self.n_honest + self.n_byz
+        if self.quorum is None:
+            # strictly more than 2/3 of total power (types/vote_set.py
+            # two-thirds majority; equal unit powers here)
+            self.quorum = (2 * n) // 3 + 1
+
+    @property
+    def n(self) -> int:
+        return self.n_honest + self.n_byz
+
+
+def byzantine_soup(cfg: Config) -> frozenset[Vote]:
+    """Every vote a byzantine validator could ever sign (flooding)."""
+    soup = set()
+    for voter in range(cfg.n_honest, cfg.n):
+        for r in range(cfg.max_round + 1):
+            for t in ("prevote", "precommit"):
+                for v in (*VALUES, NIL):
+                    soup.add(Vote(r, t, v, voter))
+    return frozenset(soup)
+
+
+def proposals(cfg: Config) -> tuple[Proposal, ...]:
+    """The proposal space: in each round, a proposal for each value with
+    each admissible POL round. Honest proposers are subsumed: whatever an
+    honest proposer would send exists here, and the PREVOTE rule guards
+    acceptance with the POL evidence check, so extra (byzantine) proposals
+    can only add behaviors, never hide a violation of the vote rules."""
+    out = []
+    for r in range(cfg.max_round + 1):
+        for v in VALUES:
+            for pol in range(-1, r):
+                out.append(Proposal(r, v, pol))
+    return tuple(out)
+
+
+def count(votes: frozenset[Vote], r: int, t: str, v: str | None) -> int:
+    """Voting power (1 each) for (round, type, value); value None = any,
+    counting DISTINCT voters (an equivocator contributes 1 to the any-vote
+    tally, exactly like types/vote_set.py sum-of-powers semantics)."""
+    if v is None:
+        return len({x.voter for x in votes if x.round == r and x.type == t})
+    return sum(1 for x in votes
+               if x.round == r and x.type == t and x.value == v)
+
+
+# ---------------------------------------------------------------------------
+# The transition relation: all enabled (validator, action) pairs.
+# Each action returns (new_state, new_votes_to_send).
+# ---------------------------------------------------------------------------
+
+
+def enabled_actions(cfg: Config, soup: frozenset[Vote],
+                    props: tuple[Proposal, ...], me: int, s: HonestState):
+    """Yield (label, new_state, sent_votes) for every rule instance honest
+    validator `me` may fire in the current message soup."""
+    if s.decided != NIL:
+        return
+    q = cfg.quorum
+    r = s.round
+
+    if s.step == PROPOSE:
+        # upon PROPOSAL(r, v, -1): prevote v iff lock allows
+        # (state_machine.py _do_prevote; Algorithm 1 line 22).
+        for p in props:
+            if p.round != r or p.pol_round != -1:
+                continue
+            ok = (not cfg.lock_rule or s.locked_round == -1
+                  or s.locked_value == p.value)
+            vote = p.value if ok else NIL
+            yield (f"prevote{r}:{vote}",
+                   replace(s, step=PREVOTE_STEP),
+                   (Vote(r, "prevote", vote, me),))
+        # upon PROPOSAL(r, v, vr) + 2f+1 PREVOTE(vr, v), vr < r
+        # (Algorithm 1 line 28; _is_proposal_complete POL evidence).
+        for p in props:
+            if p.round != r or p.pol_round < 0:
+                continue
+            if count(soup, p.pol_round, "prevote", p.value) < q:
+                continue
+            ok = (not cfg.lock_rule or s.locked_round <= p.pol_round
+                  or s.locked_value == p.value)
+            vote = p.value if ok else NIL
+            yield (f"prevote{r}:{vote}(pol{p.pol_round})",
+                   replace(s, step=PREVOTE_STEP),
+                   (Vote(r, "prevote", vote, me),))
+        # timeout_propose: prevote nil (Algorithm 1 line 57).
+        yield (f"prevote{r}:nil(timeout)",
+               replace(s, step=PREVOTE_STEP),
+               (Vote(r, "prevote", NIL, me),))
+
+    elif s.step == PREVOTE_STEP:
+        # upon 2f+1 PREVOTE(r, v): lock + precommit v
+        # (Algorithm 1 line 36; _enter_precommit polka path).
+        for v in VALUES:
+            if count(soup, r, "prevote", v) < q:
+                continue
+            ns = replace(s, step=PRECOMMIT_STEP)
+            if cfg.lock_rule:
+                ns = replace(ns, locked_value=v, locked_round=r)
+            yield (f"precommit{r}:{v}", ns, (Vote(r, "precommit", v, me),))
+        # upon 2f+1 PREVOTE(r, nil): precommit nil (line 44). A nil polka
+        # at a round above the lock releases it (_enter_precommit:782-785).
+        if count(soup, r, "prevote", NIL) >= q:
+            ns = replace(s, step=PRECOMMIT_STEP)
+            if cfg.lock_rule and s.locked_round < r:
+                ns = replace(ns, locked_value=NIL, locked_round=-1)
+            yield (f"precommit{r}:nil", ns, (Vote(r, "precommit", NIL, me),))
+        # timeout_prevote after 2f+1 any prevotes: precommit nil (line 61).
+        if count(soup, r, "prevote", None) >= q:
+            yield (f"precommit{r}:nil(timeout)",
+                   replace(s, step=PRECOMMIT_STEP),
+                   (Vote(r, "precommit", NIL, me),))
+
+    elif s.step == PRECOMMIT_STEP:
+        # timeout_precommit after 2f+1 any precommits: next round (line 65).
+        if r < cfg.max_round and count(soup, r, "precommit", None) >= q:
+            yield (f"round{r + 1}", replace(s, round=r + 1, step=PROPOSE), ())
+
+    # upon 2f+1 PRECOMMIT(r', v) at ANY time: decide v (line 49).
+    for rr in range(cfg.max_round + 1):
+        for v in VALUES:
+            if count(soup, rr, "precommit", v) >= q:
+                yield (f"decide:{v}@{rr}",
+                       replace(s, decided=v, step=DONE), ())
+
+
+# ---------------------------------------------------------------------------
+# Exhaustive DFS over all interleavings, memoized on global state.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Result:
+    states: int = 0
+    violation: tuple | None = None  # first (trace, honest_states) found
+    violations: list = field(default_factory=list)  # ALL violating states
+    lemma1_violation: tuple | None = None  # (round, soup) with two polkas
+    decisions_seen: set = field(default_factory=set)
+
+
+def explore(cfg: Config, max_states: int = 2_000_000,
+            stop_at_violation: bool = False) -> Result:
+    """DFS every reachable configuration; record the first agreement
+    violation (two honest validators decided differently) with its trace.
+
+    When f < N/3 the one-polka-per-round lemma (spec/consensus.md Lemma 1)
+    is also checked at every reached state. `stop_at_violation` aborts the
+    search at the first agreement violation (for the teeth checks, where
+    one witness trace suffices)."""
+    props = proposals(cfg)
+    byz = byzantine_soup(cfg)
+    check_lemma1 = cfg.n_byz * 3 < cfg.n
+    init = (tuple(HonestState() for _ in range(cfg.n_honest)), frozenset())
+    seen = set()
+    res = Result()
+    stack = [(init, ())]
+    while stack:
+        (honest, sent), trace = stack.pop()
+        if (honest, sent) in seen:
+            continue
+        seen.add((honest, sent))
+        res.states += 1
+        if res.states > max_states:
+            raise RuntimeError(f"state budget exceeded ({max_states})")
+        decided = [s.decided for s in honest if s.decided != NIL]
+        res.decisions_seen.update(decided)
+        if len(set(decided)) > 1:
+            if res.violation is None:
+                res.violation = (trace, honest)
+            res.violations.append((trace, honest))
+            if stop_at_violation:
+                return res
+            continue  # no need to extend a violating trace
+        soup = byz | sent
+        if check_lemma1 and res.lemma1_violation is None:
+            for r in range(cfg.max_round + 1):
+                polkas = [v for v in VALUES
+                          if count(soup, r, "prevote", v) >= cfg.quorum]
+                if len(polkas) > 1:
+                    res.lemma1_violation = (r, soup)
+        for i, s in enumerate(honest):
+            for label, ns, out in enabled_actions(cfg, soup, props, i, s):
+                nh = tuple(ns if j == i else h for j, h in enumerate(honest))
+                nsent = sent | frozenset(out)
+                if (nh, nsent) not in seen:
+                    stack.append(((nh, nsent), trace + ((i, label),)))
+    return res
+
+
+# ---------------------------------------------------------------------------
+# Accountability over a fork trace.
+# ---------------------------------------------------------------------------
+
+
+def fork_blame(cfg: Config, trace, honest) -> set[int]:
+    """Given a violating trace, rebuild every vote each validator signed
+    (honest from the trace, byzantine = the flood) and return the
+    validators holding provably contradictory signatures: two votes at one
+    (round, type) for different values — the DuplicateVoteEvidence shape
+    (types/vote_set.py conflict detection; evidence/pool.py
+    _verify_duplicate_vote).
+
+    The claim this checks, over EVERY fork the explorer can produce: blame
+    always localizes to >= f+1 validators and NEVER touches an honest one
+    (honest rule-followers cast at most one vote per (round, type) by
+    construction of the step machine). The byzantine flood signs
+    everything, so byzantine signers carry contradictions by definition —
+    the load-bearing assertion is the honest side."""
+    sent: dict[tuple[int, int, str], set[str]] = {}
+    for i, label in trace:
+        if label.startswith("prevote") or label.startswith("precommit"):
+            t = "prevote" if label.startswith("prevote") else "precommit"
+            r = int(label[len(t):label.index(":")])
+            v = label.split(":", 1)[1].split("(", 1)[0]
+            v = NIL if v == "nil" else v
+            sent.setdefault((i, r, t), set()).add(v)
+    # Byzantine flood: everything signed (same soup explore() used).
+    for vt in byzantine_soup(cfg):
+        sent.setdefault((vt.voter, vt.round, vt.type), set()).add(vt.value)
+    blamed = set()
+    for (voter, _r, _t), vals in sent.items():
+        concrete = vals - {NIL}
+        if len(concrete) > 1 or (concrete and NIL in vals):
+            blamed.add(voter)
+    return blamed

@@ -39,6 +39,8 @@ _SLOW_MODULES = {
     # kernel-bound: wide batches / fresh XLA shapes on the 1-core CPU mesh
     "test_multichip", "test_perf_gate", "test_sr25519_batch",
     "test_ed25519_batch",
+    # exhaustive state-space exploration (spec/model.py)
+    "test_spec_model",
 }
 
 
