@@ -84,6 +84,30 @@ def _decode_msg(buf: bytes):
     raise CorruptedWALError("empty WAL message")
 
 
+def _valid_frames(data: bytes):
+    """Yield (pos, end, time_ns, msg) for each valid frame of a chunk,
+    stopping at the first torn/truncated/corrupt/undecodable frame — the
+    ONE definition of frame validity, shared by replay and repair so the
+    two can never disagree on where the valid prefix ends."""
+    pos = 0
+    while pos + 8 <= len(data):
+        crc, length = struct.unpack_from(">II", data, pos)
+        if length > MAX_MSG_SIZE_BYTES or pos + 8 + length > len(data):
+            return
+        body = data[pos + 8 : pos + 8 + length]
+        if zlib.crc32(body) & 0xFFFFFFFF != crc:
+            return
+        try:
+            f2 = proto.fields(body)
+            time_ns = proto.as_sint64(f2.get(1, [0])[-1])
+            msg = _decode_msg(f2.get(2, [b""])[-1])
+        except (CorruptedWALError, ValueError):
+            return
+        end = pos + 8 + length
+        yield pos, end, time_ns, msg
+        pos = end
+
+
 class WAL:
     """reference: consensus/wal.go BaseWAL."""
 
@@ -94,6 +118,7 @@ class WAL:
         self._mtx = threading.Lock()
         self._head: object | None = None
         self._head_index = self._max_index()
+        self._repair_head()
         self._open_head()
 
     # --- chunk management (autofile group light) ---------------------------
@@ -117,6 +142,44 @@ class WAL:
 
     def _open_head(self) -> None:
         self._head = open(self._chunk_path(self._head_index), "ab")
+
+    def _repair_head(self) -> None:
+        """Truncate a torn/corrupt tail of the head chunk before appending,
+        keeping the damaged original aside — otherwise new frames land
+        AFTER the garbage and replay (which stops at the first bad frame)
+        would never reach them (reference: consensus/replay.go:73
+        repairWalFile, invoked on data corruption during catchup).
+
+        Crash-safe order: the truncated prefix is written+fsync'd to a temp
+        file first, the damaged original is hard-linked aside, and only then
+        is the temp atomically renamed over the original — a crash at any
+        point leaves either the original or the repaired file in place,
+        never neither."""
+        path = self._chunk_path(self._head_index)
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            data = f.read()
+        end = 0
+        for _pos, frame_end, _t, _m in _valid_frames(data):
+            end = frame_end
+        if end >= len(data):
+            return  # clean tail
+        tmp = path + ".repair.tmp"
+        with open(tmp, "wb") as dst:
+            dst.write(data[:end])
+            dst.flush()
+            os.fsync(dst.fileno())
+        n = 0
+        while os.path.exists(f"{path}.corrupted.{n}"):
+            n += 1
+        os.link(path, f"{path}.corrupted.{n}")
+        os.replace(tmp, path)
+        dirfd = os.open(self.dir, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
 
     def _maybe_rotate(self) -> None:
         if self._head.tell() >= self.head_size_limit:
@@ -169,24 +232,12 @@ class WAL:
             path = self._chunk_path(index)
             with open(path, "rb") as f:
                 data = f.read()
-            pos = 0
-            while pos + 8 <= len(data):
-                crc, length = struct.unpack_from(">II", data, pos)
-                if length > MAX_MSG_SIZE_BYTES:
-                    return  # corrupt tail
-                if pos + 8 + length > len(data):
-                    return  # truncated tail (crash mid-write)
-                body = data[pos + 8 : pos + 8 + length]
-                if zlib.crc32(body) & 0xFFFFFFFF != crc:
-                    return  # corrupt tail
-                f2 = proto.fields(body)
-                time_ns = proto.as_sint64(f2.get(1, [0])[-1])
-                try:
-                    msg = _decode_msg(f2.get(2, [b""])[-1])
-                except CorruptedWALError:
-                    return
+            end = 0
+            for pos, fend, time_ns, msg in _valid_frames(data):
                 yield TimedWALMessage(time_ns=time_ns, msg=msg), (index, pos)
-                pos += 8 + length
+                end = fend
+            if end < len(data):
+                return  # corrupt/torn tail: nothing after it is trustworthy
 
     def search_for_end_height(self, height: int):
         """Find messages after EndHeightMessage{height} (reference:

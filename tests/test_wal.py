@@ -1,0 +1,171 @@
+"""WAL corruption tolerance — the analogue of the reference's
+consensus/wal_fuzz.go + wal corrupt-tail handling (consensus/wal.go:231).
+
+The recovery property: whatever bytes end up on disk after a crash or
+corruption, replay (a) never raises and (b) yields a PREFIX of the
+messages that were written, in order."""
+
+import os
+import random
+import struct
+
+from tendermint_tpu.consensus.wal import (
+    WAL,
+    EndHeightMessage,
+    WALMessageBlob,
+)
+
+
+def _write_wal(path, n=20):
+    wal = WAL(path)
+    msgs = []
+    for i in range(n):
+        if i % 5 == 4:
+            m = EndHeightMessage(height=i // 5 + 1)
+        else:
+            m = WALMessageBlob(kind="vote", payload=b"payload-%d" % i * 3,
+                               peer_id="peer%d" % (i % 3))
+        wal.write_sync(m, time_ns=1_700_000_000_000_000_000 + i)
+        msgs.append(m)
+    wal.close()
+    return msgs
+
+
+def _head_file(path):
+    names = [n for n in os.listdir(path)]
+    assert names
+    return os.path.join(path, sorted(names)[-1])
+
+
+def _replayed(path):
+    return [tm.msg for tm, _ in WAL(path).iter_messages()]
+
+
+def _is_prefix(got, wrote):
+    return len(got) <= len(wrote) and got == wrote[: len(got)]
+
+
+def test_truncation_at_every_byte_is_a_prefix(tmp_path):
+    """Crash mid-write: cut the head file at every possible byte offset;
+    replay must never raise and always yield a prefix."""
+    base = _write_wal(str(tmp_path / "wal"), n=8)
+    head = _head_file(str(tmp_path / "wal"))
+    full = open(head, "rb").read()
+    for cut in range(len(full) + 1):
+        d = str(tmp_path / ("cut%d" % cut))
+        os.makedirs(d)
+        with open(os.path.join(d, os.path.basename(head)), "wb") as f:
+            f.write(full[:cut])
+        got = _replayed(d)
+        assert _is_prefix(got, base), cut
+    # the untouched file replays everything
+    assert _replayed(str(tmp_path / "wal")) == base
+
+
+def test_random_bit_flips_yield_prefix(tmp_path):
+    """Flip random bytes anywhere in the log; replay stops at (or before)
+    the first damaged frame, never raises, never yields altered/reordered
+    messages for frames whose CRC still matches."""
+    rng = random.Random(0xDEAD)
+    base = _write_wal(str(tmp_path / "wal"), n=20)
+    head = _head_file(str(tmp_path / "wal"))
+    full = bytearray(open(head, "rb").read())
+    for trial in range(60):
+        data = bytearray(full)
+        for _ in range(rng.randint(1, 4)):
+            i = rng.randrange(len(data))
+            data[i] ^= 1 << rng.randrange(8)
+        d = str(tmp_path / ("flip%d" % trial))
+        os.makedirs(d)
+        with open(os.path.join(d, os.path.basename(head)), "wb") as f:
+            f.write(bytes(data))
+        got = _replayed(d)
+        assert _is_prefix(got, base), trial
+
+
+def test_giant_length_field_stops_replay(tmp_path):
+    """A corrupted length field larger than MAX_MSG_SIZE must terminate
+    replay instead of attempting a giant allocation."""
+    base = _write_wal(str(tmp_path / "wal"), n=6)
+    head = _head_file(str(tmp_path / "wal"))
+    data = bytearray(open(head, "rb").read())
+    # frame 0 is intact; overwrite frame 1's length with 512 MiB
+    _, l0 = struct.unpack_from(">II", data, 0)
+    struct.pack_into(">I", data, 8 + l0 + 4, 512 * 1024 * 1024)
+    with open(head, "wb") as f:
+        f.write(bytes(data))
+    got = _replayed(str(tmp_path / "wal"))
+    assert got == base[:1]
+
+
+def test_search_for_end_height_on_corrupt_tail(tmp_path):
+    """EndHeight found before the damage still anchors recovery; an
+    EndHeight after the damage is unreachable and reports not-found."""
+    _write_wal(str(tmp_path / "wal"), n=20)  # EndHeights 1..4
+    head = _head_file(str(tmp_path / "wal"))
+    data = bytearray(open(head, "rb").read())
+    frames = []
+    pos = 0
+    while pos + 8 <= len(data):
+        _, ln = struct.unpack_from(">II", data, pos)
+        frames.append(pos)
+        pos += 8 + ln
+    # damage the 13th frame: EndHeight(2) at frame index 9 stays readable,
+    # EndHeight(3) at frame 14 becomes unreachable
+    data[frames[12] + 8] ^= 0xFF
+    with open(head, "wb") as f:
+        f.write(bytes(data))
+    wal = WAL(str(tmp_path / "wal"))
+    after = wal.search_for_end_height(2)
+    assert after is not None and len(after) == 2  # frames 10,11 survive
+    assert wal.search_for_end_height(3) is None
+
+
+def test_append_after_corrupt_tail_recovers_new_writes(tmp_path):
+    """Reopening a WAL with a torn tail must truncate the garbage before
+    appending (consensus/wal.py _repair_head; reference:
+    consensus/replay.go:73 repairWalFile) — otherwise the new frames land
+    after the tear and replay never reaches them."""
+    base = _write_wal(str(tmp_path / "wal"), n=5)
+    head = _head_file(str(tmp_path / "wal"))
+    with open(head, "ab") as f:
+        f.write(b"\x00\x01\x02")  # torn partial frame
+    wal = WAL(str(tmp_path / "wal"))  # repair on open
+    extra = WALMessageBlob(kind="vote", payload=b"post-crash", peer_id="p")
+    wal.write_sync(extra, time_ns=1)
+    wal.close()
+    # old prefix AND the post-crash write both replay
+    assert _replayed(str(tmp_path / "wal")) == base + [extra]
+    # the damaged original is kept aside for forensics
+    assert any(".corrupted." in n for n in os.listdir(str(tmp_path / "wal")))
+
+
+def test_repair_mid_file_corruption_truncates_to_valid_prefix(tmp_path):
+    """Damage in the middle: repair keeps the valid prefix, drops the
+    damaged frame AND everything after it (those frames were unreachable
+    by replay anyway), and subsequent writes append cleanly."""
+    base = _write_wal(str(tmp_path / "wal"), n=8)
+    head = _head_file(str(tmp_path / "wal"))
+    data = bytearray(open(head, "rb").read())
+    data[8] ^= 0xFF  # corrupt frame 0's body -> whole file unreachable
+    with open(head, "wb") as f:
+        f.write(bytes(data))
+    wal = WAL(str(tmp_path / "wal"))
+    extra = WALMessageBlob(kind="vote", payload=b"fresh", peer_id="q")
+    wal.write_sync(extra, time_ns=2)
+    wal.close()
+    assert _replayed(str(tmp_path / "wal")) == [extra]
+    assert base  # (original messages preserved only in the .corrupted copy)
+
+
+def test_clean_wal_reopen_does_not_rewrite(tmp_path):
+    """Repair must be a no-op on a clean log: no .corrupted files, all
+    messages intact after reopen + append."""
+    base = _write_wal(str(tmp_path / "wal"), n=5)
+    wal = WAL(str(tmp_path / "wal"))
+    extra = WALMessageBlob(kind="vote", payload=b"more", peer_id="r")
+    wal.write_sync(extra, time_ns=3)
+    wal.close()
+    assert _replayed(str(tmp_path / "wal")) == base + [extra]
+    assert not any(".corrupted." in n
+                   for n in os.listdir(str(tmp_path / "wal")))
