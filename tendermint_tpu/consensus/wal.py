@@ -118,7 +118,7 @@ class WAL:
         self._mtx = threading.Lock()
         self._head: object | None = None
         self._head_index = self._max_index()
-        self._repair_head()
+        self._repair()
         self._open_head()
 
     # --- chunk management (autofile group light) ---------------------------
@@ -143,38 +143,58 @@ class WAL:
     def _open_head(self) -> None:
         self._head = open(self._chunk_path(self._head_index), "ab")
 
-    def _repair_head(self) -> None:
-        """Truncate a torn/corrupt tail of the head chunk before appending,
-        keeping the damaged original aside — otherwise new frames land
-        AFTER the garbage and replay (which stops at the first bad frame)
-        would never reach them (reference: consensus/replay.go:73
-        repairWalFile, invoked on data corruption during catchup).
+    def _repair(self) -> None:
+        """Make the on-disk log append-safe again after damage: replay
+        stops at the first torn/corrupt frame in ANY chunk, so everything
+        from that point on — the damaged chunk's tail, all later chunks,
+        and any frame a reopened node would append — is unreachable. On
+        open, find the first chunk with a non-clean tail, truncate it to
+        its valid prefix, retire every later chunk (messages after a lost
+        frame must not replay — ordering across the gap is broken), and
+        point appends at the repaired chunk. Damaged originals are kept
+        aside as .corrupted.N for forensics (reference:
+        consensus/replay.go:73 repairWalFile).
 
-        Crash-safe order: the truncated prefix is written+fsync'd to a temp
-        file first, the damaged original is hard-linked aside, and only then
-        is the temp atomically renamed over the original — a crash at any
-        point leaves either the original or the repaired file in place,
-        never neither."""
-        path = self._chunk_path(self._head_index)
-        if not os.path.exists(path):
+        Crash-safe order: later chunks are retired highest-index-first,
+        then the torn chunk is replaced via write-temp + fsync + hard-link
+        original aside + atomic rename + directory fsync. At every
+        intermediate state the replayable prefix is unchanged (replay
+        still stops at the tear), and a re-crash just repeats the repair."""
+        torn = None
+        for index in self._indexes():
+            path = self._chunk_path(index)
+            with open(path, "rb") as f:
+                data = f.read()
+            end = 0
+            for _pos, frame_end, _t, _m in _valid_frames(data):
+                end = frame_end
+            if end < len(data):
+                torn = (index, data, end)
+                break
+        if torn is None:
             return
-        with open(path, "rb") as f:
-            data = f.read()
-        end = 0
-        for _pos, frame_end, _t, _m in _valid_frames(data):
-            end = frame_end
-        if end >= len(data):
-            return  # clean tail
-        tmp = path + ".repair.tmp"
-        with open(tmp, "wb") as dst:
-            dst.write(data[:end])
-            dst.flush()
-            os.fsync(dst.fileno())
+        index, data, end = torn
+        for later in reversed([i for i in self._indexes() if i > index]):
+            self._retire(self._chunk_path(later), keep_prefix=None)
+        self._retire(self._chunk_path(index), keep_prefix=data[:end])
+        self._head_index = index
+
+    def _retire(self, path: str, keep_prefix: bytes | None) -> None:
+        """Move `path` aside as .corrupted.N; when keep_prefix is given,
+        atomically replace it with that prefix instead of removing it."""
         n = 0
         while os.path.exists(f"{path}.corrupted.{n}"):
             n += 1
-        os.link(path, f"{path}.corrupted.{n}")
-        os.replace(tmp, path)
+        if keep_prefix is None:
+            os.replace(path, f"{path}.corrupted.{n}")
+        else:
+            tmp = path + ".repair.tmp"
+            with open(tmp, "wb") as dst:
+                dst.write(keep_prefix)
+                dst.flush()
+                os.fsync(dst.fileno())
+            os.link(path, f"{path}.corrupted.{n}")
+            os.replace(tmp, path)
         dirfd = os.open(self.dir, os.O_RDONLY)
         try:
             os.fsync(dirfd)

@@ -25,23 +25,32 @@ CHAIN_ID = "perf-gate-chain"
 WALL_CEILING_S = {256: 20.0, 1024: 40.0}
 
 
-def _commit(n):
+def _signed_commit(vals, privs, height, round_, bid, ts):
+    """One precommit per validator over the canonical sign bytes — the
+    single commit builder every gate in this module uses."""
+    sigs = []
+    for i, (p, v) in enumerate(zip(privs, vals.validators)):
+        vote = Vote(type=PRECOMMIT_TYPE, height=height, round=round_,
+                    block_id=bid, timestamp=ts, validator_address=v.address,
+                    validator_index=i)
+        sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, v.address, ts,
+                              p.sign(vote.sign_bytes(CHAIN_ID))))
+    return Commit(height=height, round=round_, block_id=bid, signatures=sigs)
+
+
+def _mk_vals(n):
     privs = [ed25519.gen_priv_key((i + 1).to_bytes(2, "big") * 16)
              for i in range(n)]
     vals = ValidatorSet([Validator.new(p.pub_key(), 10) for p in privs])
     by_addr = {p.pub_key().address(): p for p in privs}
-    privs = [by_addr[v.address] for v in vals.validators]
+    return [by_addr[v.address] for v in vals.validators], vals
+
+
+def _commit(n):
+    privs, vals = _mk_vals(n)
     bid = BlockID(hash=b"\x42" * 32,
                   part_set_header=PartSetHeader(total=1, hash=b"\x43" * 32))
-    ts = Time(1_700_000_500, 0)
-    sigs = []
-    for i, (p, v) in enumerate(zip(privs, vals.validators)):
-        vote = Vote(type=PRECOMMIT_TYPE, height=3, round=0, block_id=bid,
-                    timestamp=ts, validator_address=v.address,
-                    validator_index=i)
-        sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, v.address, ts,
-                              p.sign(vote.sign_bytes(CHAIN_ID))))
-    return vals, Commit(height=3, round=0, block_id=bid, signatures=sigs)
+    return vals, _signed_commit(vals, privs, 3, 0, bid, Time(1_700_000_500, 0))
 
 
 class _FlushCounter:
@@ -89,3 +98,56 @@ def test_verify_commit_stays_batched(n_vals, monkeypatch):
     ceiling = WALL_CEILING_S[n_vals]
     assert full_s < ceiling, f"verify_commit {full_s:.1f}s > {ceiling}s"
     assert light_s < ceiling, f"verify_commit_light {light_s:.1f}s > {ceiling}s"
+
+
+def test_range_verify_one_flush_and_no_scalar_header_hashing(monkeypatch):
+    """BASELINE config 3's shape must not silently regress: the whole range
+    verifies in EXACTLY one kernel flush, and header hashing goes through
+    the batched merkle forest (precompute fills every cache; the scalar
+    fallback inside Header.hash must not run for range members)."""
+    from tendermint_tpu.light.range_verify import verify_header_range
+    from tendermint_tpu.types.block import Header
+    from tendermint_tpu.types.light_block import LightBlock, SignedHeader
+
+    n_headers = 65
+    privs, vals = _mk_vals(1)
+    chain = []
+    last_bid = BlockID()
+    for h in range(1, n_headers + 1):
+        header = Header(chain_id=CHAIN_ID, height=h, time=Time(1_700_000_000 + 10 * h, 0),
+                        last_block_id=last_bid, validators_hash=vals.hash(),
+                        next_validators_hash=vals.hash(),
+                        proposer_address=vals.validators[0].address)
+        bid = BlockID(hash=header.hash(),
+                      part_set_header=PartSetHeader(total=1, hash=b"\x44" * 32))
+        commit = _signed_commit(vals, privs, h, 1, bid,
+                                Time(header.time.seconds, 0))
+        chain.append(LightBlock(signed_header=SignedHeader(header, commit),
+                                validator_set=vals.copy()))
+        last_bid = bid
+
+    trusted, rest = chain[0], chain[1:]
+    now = Time(1_700_000_000 + 10 * (n_headers + 2), 0)
+    verify_header_range(trusted, rest, 14 * 86400.0, now)  # warm/compile
+    for lb in rest:
+        lb.signed_header.header._hash_cache = None
+
+    from tendermint_tpu.crypto import merkle
+
+    def no_scalar_header_hash(items):
+        if len(items) == 14:
+            raise AssertionError(
+                "scalar header hash ran inside range verify; the batched "
+                "forest (precompute_header_hashes) must cover the range")
+        return orig_hash(items)
+
+    orig_hash = merkle.hash_from_byte_slices
+    fc = _FlushCounter(monkeypatch)
+    monkeypatch.setattr(merkle, "hash_from_byte_slices", no_scalar_header_hash)
+    try:
+        verify_header_range(trusted, rest, 14 * 86400.0, now)
+    finally:
+        monkeypatch.setattr(merkle, "hash_from_byte_slices", orig_hash)
+    assert fc.kernel == 1, (
+        f"range verify used {fc.kernel} kernel flushes, expected 1")
+    assert fc.scalar == 0, "range verify fell back to the scalar loop"

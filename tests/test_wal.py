@@ -123,7 +123,7 @@ def test_search_for_end_height_on_corrupt_tail(tmp_path):
 
 def test_append_after_corrupt_tail_recovers_new_writes(tmp_path):
     """Reopening a WAL with a torn tail must truncate the garbage before
-    appending (consensus/wal.py _repair_head; reference:
+    appending (consensus/wal.py _repair; reference:
     consensus/replay.go:73 repairWalFile) — otherwise the new frames land
     after the tear and replay never reaches them."""
     base = _write_wal(str(tmp_path / "wal"), n=5)
@@ -169,3 +169,35 @@ def test_clean_wal_reopen_does_not_rewrite(tmp_path):
     assert _replayed(str(tmp_path / "wal")) == base + [extra]
     assert not any(".corrupted." in n
                    for n in os.listdir(str(tmp_path / "wal")))
+
+
+def test_tear_in_rotated_chunk_repairs_and_retires_later_chunks(tmp_path):
+    """Rotation: a tear in an EARLIER (non-head) chunk used to orphan every
+    later chunk and all post-crash writes (repair only looked at the head).
+    Repair must truncate the torn chunk, retire later chunks (ordering
+    across the gap is broken), and make new writes reachable."""
+    d = str(tmp_path / "wal")
+    wal = WAL(d, head_size_limit=64)  # force rotation every frame or two
+    msgs = []
+    for i in range(10):
+        m = WALMessageBlob(kind="vote", payload=b"chunked-%d" % i * 4,
+                           peer_id="p")
+        wal.write_sync(m, time_ns=i)
+        msgs.append(m)
+    wal.close()
+    chunks = sorted(n for n in os.listdir(d) if ".corrupted." not in n)
+    assert len(chunks) >= 3, chunks  # rotation actually happened
+    # tear the tail of the FIRST chunk
+    first = os.path.join(d, chunks[0])
+    with open(first, "ab") as f:
+        f.write(b"\x00\x01")
+    wal2 = WAL(d, head_size_limit=64)
+    extra = WALMessageBlob(kind="vote", payload=b"post-tear", peer_id="q")
+    wal2.write_sync(extra, time_ns=99)
+    wal2.close()
+    got = [tm.msg for tm, _ in WAL(d, head_size_limit=64).iter_messages()]
+    # the first chunk's valid frames survive, later chunks are retired,
+    # and the post-tear write is REACHABLE
+    assert got and got[-1] == extra
+    assert _is_prefix(got[:-1], msgs)
+    assert any(".corrupted." in n for n in os.listdir(d))
