@@ -393,7 +393,7 @@ def _r_to_limbs(r32: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 
 
 def prepare_scalars(items, pub_ok: np.ndarray, windows: bool = True,
-                    reduce: bool = True):
+                    reduce: bool = True, host_hash: bool = True):
     """Vectorized per-signature prep: scalars, R bytes, validity.
 
     items: [(pub, msg, sig)]; pub_ok from get_keyset. Returns dict of numpy
@@ -401,7 +401,10 @@ def prepare_scalars(items, pub_ok: np.ndarray, windows: bool = True,
     path) the comb windows are left to the device and only raw h32/s32
     scalars are produced -- 40% less H2D payload. With reduce=False the
     mod-L reduction is ALSO left to the device: the dict carries the raw
-    (N, 64) SHA-512 digests as "h64" and no "h32"."""
+    (N, 64) SHA-512 digests as "h64" and no "h32". With host_hash=False
+    even the SHA-512 stays off host: no "h64"; the dict carries "pubs32"
+    so the caller can pack R||A||M for the device hasher
+    (ops/sha512_jax)."""
     n = len(items)
     sig_ok = np.fromiter(
         (len(it[2]) == ref.SIGNATURE_SIZE for it in items), dtype=bool, count=n
@@ -422,10 +425,13 @@ def prepare_scalars(items, pub_ok: np.ndarray, windows: bool = True,
     pubs_arr = np.frombuffer(pubs32, dtype=np.uint8).reshape(n, 32)
 
     s_lt = sc.lt_l(s32)
-    digests = chash.sha512_rab(r32, np.ascontiguousarray(pubs_arr),
-                               [it[1] for it in items])
     valid = sig_ok & s_lt & pub_ok
     out = dict(s32=s32, r32=r32, valid=valid)
+    if not host_hash:
+        out["pubs32"] = np.ascontiguousarray(pubs_arr)
+        return out
+    digests = chash.sha512_rab(r32, np.ascontiguousarray(pubs_arr),
+                               [it[1] for it in items])
     if not reduce:
         out["h64"] = digests
         return out

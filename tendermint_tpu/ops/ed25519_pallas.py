@@ -507,12 +507,38 @@ def dispatch_items_pipelined(ks, key_idx: np.ndarray, items, pub_ok):
     prep-everything-then-dispatch path."""
     from tendermint_tpu.ops import ed25519_batch as edb
 
+    from tendermint_tpu.ops import sha512_jax
+
     n = len(items)
+    use_dev_sha = sha512_jax.enabled()
+
+    h64_full = None
+    preps = None
+    if use_dev_sha:
+        # Opt-in (TM_TPU_DEVICE_SHA=1): hash the WHOLE batch in one device
+        # call and slice digest columns per chunk. Measured slower than the
+        # C host hash on the bench host (see ops/sha512_jax docstring) —
+        # kept for hosts whose CPU, not the device link, is the bottleneck.
+        # This path preps every chunk up front (no prep/compute overlap);
+        # the default path below keeps the interleaved pipeline.
+        preps = []
+        for off in range(0, n, CHUNK):
+            sl = slice(off, min(off + CHUNK, n))
+            preps.append((sl, edb.prepare_scalars(
+                items[sl], pub_ok[sl], windows=False, reduce=False,
+                host_hash=False)))
+        lanes = max(((n + CHUNK - 1) // CHUNK) * CHUNK, CHUNK)
+        r32 = np.concatenate([p["r32"] for _, p in preps])
+        pubs = np.concatenate([p["pubs32"] for _, p in preps])
+        h64_full = sha512_jax.sha512_rab_device(
+            r32, pubs, [it[1] for it in items], lanes)
+
     outs = []
-    for off in range(0, n, CHUNK):
+    for ci, off in enumerate(range(0, n, CHUNK)):
         sl = slice(off, min(off + CHUNK, n))
-        s = edb.prepare_scalars(items[sl], pub_ok[sl], windows=False,
-                                reduce=False)
+        s = (preps[ci][1] if preps is not None
+             else edb.prepare_scalars(items[sl], pub_ok[sl], windows=False,
+                                      reduce=False))
         cn = sl.stop - sl.start
         idx = np.zeros((CHUNK,), dtype=np.int32)
         idx[:cn] = key_idx[sl]
@@ -522,10 +548,22 @@ def dispatch_items_pipelined(ks, key_idx: np.ndarray, items, pub_ok):
             out[:, :cn] = x.T if x.ndim == 2 else x[None, :]
             return out
 
+        if h64_full is not None:
+            h64 = jax.lax.dynamic_slice_in_dim(h64_full, sl.start, CHUNK, 1)
+        else:
+            if "h64" not in s:
+                # Device SHA wanted but a message was too long for it:
+                # C fallback from the packed pubs.
+                from tendermint_tpu.ops import chash
+
+                s["h64"] = chash.sha512_rab(
+                    s["r32"], s["pubs32"], [it[1] for it in items[sl]])
+            h64 = jnp.asarray(pad_cols(s["h64"], 64))
+
         tab = ks.gathered_lane(idx)
         outs.append(_verify_chunk(
             tab,
-            jnp.asarray(pad_cols(s["h64"], 64)),
+            h64,
             jnp.asarray(pad_cols(s["s32"], 32)),
             jnp.asarray(pad_cols(s["r32"], 32)),
             jnp.asarray(pad_cols(s["valid"].astype(np.uint8), 1)),

@@ -1,0 +1,266 @@
+"""SHA-512 on device (XLA), vectorized over lanes — evaluated, OFF by
+default (set TM_TPU_DEVICE_SHA=1 to enable).
+
+The ed25519 batch verifier needs h = SHA-512(R || A || M) per signature
+(crypto/ed25519.verify; RFC 8032 step 2). Hashing on host costs ~1 us/sig
+of single-core C time (csrc/hash_batch.c) — the last serial term in the
+batch path — while the padded messages upload in ~3 ms for a 20k batch,
+so moving the hash on-device looked like a ~18 ms win on the headline.
+
+Measured on the v5e chip (20,480-sig commit, 2026-07-30): it is NOT one.
+The 80-round compression is scalar-heavy uint32 work the VPU has no
+leverage on — per-chunk hashing ran 155 ms vs 145 ms for the C path, and
+one whole-batch call ran 218 ms vs 163 ms (the fori_loop's dynamic W/K
+indexing dominates; a fully unrolled build compiles for 10+ minutes).
+The C SHA-512 therefore stays the default; this module remains as the
+evaluated alternative for hosts whose CPU, not PCIe, is the bottleneck.
+
+64-bit words are modeled as (hi, lo) uint32 pairs (TPUs have no native
+uint64 lanes); lanes with fewer blocks than the batch maximum freeze
+their state via a mask, so one executable serves mixed message lengths.
+Differentially tested against hashlib.sha512 across lengths including
+the one-block/two-block padding boundary (tests/test_sha512_device.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# FIPS 180-4 round constants: fractional parts of cube roots of the first
+# eighty primes, as (hi, lo) uint32 pairs.
+_PRIMES: list[int] = []
+_c = 2
+while len(_PRIMES) < 80:
+    if all(_c % p for p in _PRIMES):
+        _PRIMES.append(_c)
+    _c += 1
+
+
+def _frac_root(p: int, power: float) -> int:
+    from decimal import Decimal, getcontext
+
+    getcontext().prec = 50
+    x = Decimal(p) ** (Decimal(1) / Decimal(int(1 / power)))
+    return int((x - int(x)) * (1 << 64))
+
+
+_K64 = [_frac_root(p, 1.0 / 3.0) for p in _PRIMES]
+_H0_64 = [_frac_root(p, 0.5) for p in _PRIMES[:8]]
+# Sanity: pin against the published constants.
+assert _K64[0] == 0x428A2F98D728AE22 and _K64[79] == 0x6C44198C4A475817
+assert _H0_64[0] == 0x6A09E667F3BCC908 and _H0_64[7] == 0x5BE0CD19137E2179
+
+_K_HI = np.array([k >> 32 for k in _K64], dtype=np.uint32)
+_K_LO = np.array([k & 0xFFFFFFFF for k in _K64], dtype=np.uint32)
+
+
+def _rotr(h, l, n):  # noqa: E741 - (hi, lo) pair
+    if n < 32:
+        return ((h >> n) | (l << (32 - n)), (l >> n) | (h << (32 - n)))
+    if n == 32:
+        return l, h
+    m = n - 32
+    return ((l >> m) | (h << (32 - m)), (h >> m) | (l << (32 - m)))
+
+
+def _shr(h, l, n):  # noqa: E741 - n < 32 everywhere in SHA-512
+    return (h >> n), (l >> n) | (h << (32 - n))
+
+
+def _add2(ah, al, bh, bl):
+    lo = al + bl
+    return ah + bh + (lo < al).astype(jnp.uint32), lo
+
+
+def _add3(ah, al, bh, bl, ch, cl):
+    return _add2(*_add2(ah, al, bh, bl), ch, cl)
+
+
+def _xor2(a, b):
+    return a[0] ^ b[0], a[1] ^ b[1]
+
+
+def _schedule_body(t, w):
+    """Extend the message schedule: w is (80, 2, N) uint32."""
+    w15 = (w[t - 15, 0], w[t - 15, 1])
+    w2 = (w[t - 2, 0], w[t - 2, 1])
+    s0 = _xor2(_xor2(_rotr(*w15, 1), _rotr(*w15, 8)), _shr(*w15, 7))
+    s1 = _xor2(_xor2(_rotr(*w2, 19), _rotr(*w2, 61)), _shr(*w2, 6))
+    wt = _add3(*_add2(w[t - 16, 0], w[t - 16, 1], *s0), w[t - 7, 0],
+               w[t - 7, 1], *s1)
+    return w.at[t].set(jnp.stack(wt))
+
+
+def _round_body(t, carry):
+    """One compression round: carry is ((8, 2, N) working vars, (80,2,N) w,
+    (80,2) k)."""
+    v, w, k = carry
+    a = (v[0, 0], v[0, 1])
+    b = (v[1, 0], v[1, 1])
+    c = (v[2, 0], v[2, 1])
+    d = (v[3, 0], v[3, 1])
+    e = (v[4, 0], v[4, 1])
+    f = (v[5, 0], v[5, 1])
+    g = (v[6, 0], v[6, 1])
+    h = (v[7, 0], v[7, 1])
+    S1 = _xor2(_xor2(_rotr(*e, 14), _rotr(*e, 18)), _rotr(*e, 41))
+    ch = ((e[0] & f[0]) ^ (~e[0] & g[0]), (e[1] & f[1]) ^ (~e[1] & g[1]))
+    kt = (k[t, 0], k[t, 1])
+    wt = (w[t, 0], w[t, 1])
+    t1 = _add2(*_add3(*h, *S1, *ch), *_add2(*kt, *wt))
+    S0 = _xor2(_xor2(_rotr(*a, 28), _rotr(*a, 34)), _rotr(*a, 39))
+    maj = ((a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+           (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]))
+    t2 = _add2(*S0, *maj)
+    new_e = _add2(*d, *t1)
+    new_a = _add2(*t1, *t2)
+    nv = jnp.stack([
+        jnp.stack(new_a), jnp.stack(a), jnp.stack(b), jnp.stack(c),
+        jnp.stack(new_e), jnp.stack(e), jnp.stack(f), jnp.stack(g),
+    ])
+    return nv, w, k
+
+
+def sha512_blocks(blocks: jnp.ndarray, nblocks: jnp.ndarray) -> jnp.ndarray:
+    """blocks: (B*128, N) uint8 — per-lane padded messages, column-major
+    lanes; nblocks: (1, N) int32 — how many 128-byte blocks each lane's
+    message actually occupies (the rest are zero filler). Returns (64, N)
+    uint8 digests. B is static (shape); per-lane block counts are not.
+
+    The schedule extension and 80 rounds run as lax.fori_loops (a fully
+    unrolled build compiles for minutes on the TPU toolchain); the per-lane
+    uint64 words live as (hi, lo) uint32 pairs throughout.
+    """
+    total_rows = blocks.shape[0]
+    assert total_rows % 128 == 0
+    b_max = total_rows // 128
+    n = blocks.shape[1]
+    u = blocks.astype(jnp.uint32)
+    k = jnp.stack([jnp.asarray(_K_HI), jnp.asarray(_K_LO)], axis=1)  # (80,2)
+    k = jnp.broadcast_to(k[:, :, None], (80, 2, 1)).astype(jnp.uint32)
+    state = jnp.stack([
+        jnp.stack([jnp.full((n,), h >> 32, jnp.uint32),
+                   jnp.full((n,), h & 0xFFFFFFFF, jnp.uint32)])
+        for h in _H0_64])  # (8, 2, N)
+    for b in range(b_max):
+        base = b * 128
+        # W[0..15] from the block bytes, big-endian words.
+        w16 = []
+        for i in range(16):
+            o = base + 8 * i
+            hi = (u[o] << 24) | (u[o + 1] << 16) | (u[o + 2] << 8) | u[o + 3]
+            lo = (u[o + 4] << 24) | (u[o + 5] << 16) | (u[o + 6] << 8) | u[o + 7]
+            w16.append(jnp.stack([hi, lo]))
+        w = jnp.concatenate([jnp.stack(w16),
+                             jnp.zeros((64, 2, n), jnp.uint32)])
+        w = jax.lax.fori_loop(16, 80, _schedule_body, w)
+        v, _, _ = jax.lax.fori_loop(
+            0, 80, _round_body, (state, w, jnp.broadcast_to(k, (80, 2, n))))
+        hi_sum = state[:, 0] + v[:, 0] + (state[:, 1] + v[:, 1] < state[:, 1]
+                                          ).astype(jnp.uint32)
+        lo_sum = state[:, 1] + v[:, 1]
+        new_state = jnp.stack([hi_sum, lo_sum], axis=1)
+        active = nblocks[0] > b
+        state = jnp.where(active, new_state, state)
+    out = []
+    for i in range(8):
+        for word, sh in ((state[i, 0], 24), (state[i, 0], 16),
+                         (state[i, 0], 8), (state[i, 0], 0),
+                         (state[i, 1], 24), (state[i, 1], 16),
+                         (state[i, 1], 8), (state[i, 1], 0)):
+            out.append((word >> sh) & 0xFF)
+    return jnp.stack(out).astype(jnp.uint8)
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing of R || A || M with SHA-512 padding
+# ---------------------------------------------------------------------------
+
+_PAD_CACHE: dict[tuple[int, int], bytes] = {}
+
+
+def n_blocks(msg_len: int) -> int:
+    """Blocks for a 64 + msg_len byte message (R||A prefix) with padding."""
+    return (64 + msg_len + 17 + 127) // 128
+
+
+def _suffix(msg_len: int, rows: int) -> bytes:
+    """0x80 || zeros || 128-bit BE bit length, then zero-fill to `rows`
+    total bytes for the 64+msg_len-byte message."""
+    key = (msg_len, rows)
+    sfx = _PAD_CACHE.get(key)
+    if sfx is None:
+        total = 64 + msg_len
+        padded = n_blocks(msg_len) * 128
+        sfx = (b"\x80" + b"\x00" * (padded - total - 17)
+               + (8 * total).to_bytes(16, "big")
+               + b"\x00" * (rows - padded))
+        _PAD_CACHE[key] = sfx
+    return sfx
+
+
+def pack_rab(r32: np.ndarray, pubs: np.ndarray, msgs: list[bytes],
+             rows: int) -> tuple[np.ndarray, np.ndarray]:
+    """Build ((N, rows) uint8 padded R||A||M buffers, (N,) int32 block
+    counts). rows must be a multiple of 128 covering every message."""
+    n = len(msgs)
+    rb, ab = r32.tobytes(), pubs.tobytes()
+    parts = []
+    counts = np.empty((n,), dtype=np.int32)
+    for i, m in enumerate(msgs):
+        parts.append(rb[32 * i : 32 * i + 32])
+        parts.append(ab[32 * i : 32 * i + 32])
+        parts.append(m)
+        parts.append(_suffix(len(m), rows))
+        counts[i] = n_blocks(len(m))
+    buf = np.frombuffer(b"".join(parts), dtype=np.uint8)
+    return buf.reshape(n, rows), counts
+
+
+# Device SHA-512 handles up to this many blocks; longer messages fall back
+# to the C path (csrc/hash_batch.c). Canonical votes are always 2 blocks.
+MAX_DEVICE_BLOCKS = 8
+
+
+def enabled() -> bool:
+    """Opt-in: the C host hash measured faster on the bench host (see
+    module docstring)."""
+    return os.environ.get("TM_TPU_DEVICE_SHA", "0") == "1"
+
+
+def bucket_blocks(b: int) -> int:
+    """Pad the static block dimension to {2, 4, 8} so odd message lengths
+    don't each compile a fresh executable."""
+    for cap in (2, 4, 8):
+        if b <= cap:
+            return cap
+    raise ValueError(f"{b} blocks exceeds MAX_DEVICE_BLOCKS")
+
+
+_sha512_blocks_jit = jax.jit(sha512_blocks)
+
+
+def sha512_rab_device(r32: np.ndarray, pubs: np.ndarray, msgs: list[bytes],
+                      lanes: int) -> jnp.ndarray | None:
+    """Dispatch SHA-512(R||A||M) for a chunk: returns a (64, lanes) uint8
+    device array future, or None when any message is too long for the
+    device path (caller falls back to C). `lanes` pads the lane axis;
+    trailing pad lanes have nblocks=0 and emit the raw SHA-512 initial
+    state, so callers MUST mask them by validity."""
+    if not msgs:
+        return None
+    longest = max(len(m) for m in msgs)
+    if n_blocks(longest) > MAX_DEVICE_BLOCKS:
+        return None
+    b = bucket_blocks(n_blocks(longest))
+    rows = b * 128
+    buf, counts = pack_rab(r32, pubs, msgs, rows)
+    blocks = np.zeros((lanes, rows), dtype=np.uint8)
+    blocks[: len(msgs)] = buf
+    nb = np.zeros((1, lanes), dtype=np.int32)
+    nb[0, : len(msgs)] = counts
+    return _sha512_blocks_jit(jnp.asarray(blocks.T), jnp.asarray(nb))
