@@ -511,6 +511,19 @@ def dispatch_items_pipelined(ks, key_idx: np.ndarray, items, pub_ok):
 
     n = len(items)
     use_dev_sha = sha512_jax.enabled()
+    if use_dev_sha and any(
+            sha512_jax.n_blocks(len(it[1])) > sha512_jax.MAX_DEVICE_BLOCKS
+            for it in items):
+        # One over-long message would force a C fallback AFTER the eager
+        # prep phase — the worst of both paths. Decide up front and keep
+        # the interleaved default pipeline instead.
+        import warnings
+
+        warnings.warn(
+            "TM_TPU_DEVICE_SHA=1 but a message exceeds the device hash's "
+            f"{sha512_jax.MAX_DEVICE_BLOCKS * 128}-byte limit; using the "
+            "C host hash for this batch", stacklevel=2)
+        use_dev_sha = False
 
     h64_full = None
     preps = None
@@ -532,6 +545,7 @@ def dispatch_items_pipelined(ks, key_idx: np.ndarray, items, pub_ok):
         pubs = np.concatenate([p["pubs32"] for _, p in preps])
         h64_full = sha512_jax.sha512_rab_device(
             r32, pubs, [it[1] for it in items], lanes)
+        assert h64_full is not None  # lengths prechecked above
 
     outs = []
     for ci, off in enumerate(range(0, n, CHUNK)):
@@ -551,13 +565,6 @@ def dispatch_items_pipelined(ks, key_idx: np.ndarray, items, pub_ok):
         if h64_full is not None:
             h64 = jax.lax.dynamic_slice_in_dim(h64_full, sl.start, CHUNK, 1)
         else:
-            if "h64" not in s:
-                # Device SHA wanted but a message was too long for it:
-                # C fallback from the packed pubs.
-                from tendermint_tpu.ops import chash
-
-                s["h64"] = chash.sha512_rab(
-                    s["r32"], s["pubs32"], [it[1] for it in items[sl]])
             h64 = jnp.asarray(pad_cols(s["h64"], 64))
 
         tab = ks.gathered_lane(idx)

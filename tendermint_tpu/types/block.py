@@ -279,35 +279,15 @@ class Commit:
     def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
         """Canonical sign bytes for the precommit in slot val_idx —
         equivalent to get_vote(val_idx).sign_bytes(chain_id) (differential-
-        tested). All fields except the per-signature timestamp are constant
-        across a commit (height/round/block_id never mutate after
-        construction), so the constant prefix/suffix per block-id flag is
-        templated once and the timestamp spliced in; verify_commit-style
-        loops pay one Writer build per commit instead of one per vote."""
-        from tendermint_tpu.types.vote import canonical_block_id_bytes
+        tested). Rides canonical_vote_bytes' template cache, so
+        verify_commit-style loops pay one Writer build per (commit, flag)
+        instead of one per vote."""
+        from tendermint_tpu.types.vote import canonical_vote_bytes
 
-        cache = getattr(self, "_sb_cache", None)
-        if cache is None or cache[0] != chain_id:
-            cache = (chain_id, {})
-            self._sb_cache = cache
         cs = self.signatures[val_idx]
-        tmpl = cache[1].get(cs.block_id_flag)
-        if tmpl is None:
-            w = proto.Writer()
-            w.varint(1, PRECOMMIT_TYPE)
-            w.sfixed64(2, self.height)
-            w.sfixed64(3, self.round)
-            cbid = canonical_block_id_bytes(cs.block_id(self.block_id))
-            if cbid is not None:
-                w.message(4, cbid, always=True)
-            suffix = proto.Writer().string(6, chain_id).out()
-            tmpl = (w.out(), suffix)
-            cache[1][cs.block_id_flag] = tmpl
-        pre, suf = tmpl
-        tsm = cs.timestamp.marshal()
-        # field 5 (timestamp), wire type 2: tag 0x2a; always emitted.
-        body = pre + b"\x2a" + proto.encode_uvarint(len(tsm)) + tsm + suf
-        return proto.delimited(body)
+        return canonical_vote_bytes(chain_id, PRECOMMIT_TYPE, self.height,
+                                    self.round, cs.block_id(self.block_id),
+                                    cs.timestamp)
 
     def size(self) -> int:
         return len(self.signatures)

@@ -46,20 +46,39 @@ def canonical_block_id_bytes(bid: BlockID) -> bytes | None:
     )
 
 
+_CV_TEMPLATES: dict = {}
+
+
 def canonical_vote_bytes(chain_id: str, vtype: int, height: int, round_: int,
                          block_id: BlockID, timestamp: Time) -> bytes:
     """Delimited CanonicalVote marshal = the exact signed payload
-    (reference: types/vote.go:93 VoteSignBytes)."""
-    w = proto.Writer()
-    w.varint(1, vtype)
-    w.sfixed64(2, height)
-    w.sfixed64(3, round_)
-    cbid = canonical_block_id_bytes(block_id)
-    if cbid is not None:
-        w.message(4, cbid, always=True)
-    w.message(5, timestamp.marshal(), always=True)
-    w.string(6, chain_id)
-    return proto.delimited(w.out())
+    (reference: types/vote.go:93 VoteSignBytes).
+
+    In a vote drain every field except the timestamp repeats per
+    (chain_id, type, height, round, block_id), so the constant prefix and
+    suffix are templated (bounded cache) and the timestamp spliced in —
+    differential-tested against the plain construction."""
+    key = (chain_id, vtype, height, round_,
+           block_id.hash, block_id.part_set_header.total,
+           block_id.part_set_header.hash)
+    tmpl = _CV_TEMPLATES.get(key)
+    if tmpl is None:
+        if len(_CV_TEMPLATES) >= 64:  # a handful of (height, round) shapes live at once
+            _CV_TEMPLATES.clear()
+        w = proto.Writer()
+        w.varint(1, vtype)
+        w.sfixed64(2, height)
+        w.sfixed64(3, round_)
+        cbid = canonical_block_id_bytes(block_id)
+        if cbid is not None:
+            w.message(4, cbid, always=True)
+        tmpl = (w.out(), proto.Writer().string(6, chain_id).out())
+        _CV_TEMPLATES[key] = tmpl
+    pre, suf = tmpl
+    tsm = timestamp.marshal()
+    # field 5 (timestamp), wire type 2: tag 0x2a; always emitted.
+    return proto.delimited(pre + b"\x2a" + proto.encode_uvarint(len(tsm))
+                           + tsm + suf)
 
 
 @dataclass

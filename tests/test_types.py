@@ -330,3 +330,43 @@ def test_commit_vote_sign_bytes_template_differential():
         for i in range(len(sigs)):
             assert (c.vote_sign_bytes(chain_id, i)
                     == c.get_vote(i).sign_bytes(chain_id)), (chain_id, i)
+
+
+def test_canonical_vote_bytes_template_cache_differential():
+    """canonical_vote_bytes' template cache must be invisible: byte-equal
+    to a fresh construction across types, rounds, nil block ids, many
+    timestamps, and cache eviction (types/vote.py)."""
+    from tendermint_tpu.encoding import proto
+    from tendermint_tpu.types import vote as vmod
+    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+    from tendermint_tpu.types.ttime import Time
+
+    def fresh(chain_id, vtype, height, round_, bid, ts):
+        w = proto.Writer()
+        w.varint(1, vtype)
+        w.sfixed64(2, height)
+        w.sfixed64(3, round_)
+        cbid = vmod.canonical_block_id_bytes(bid)
+        if cbid is not None:
+            w.message(4, cbid, always=True)
+        w.message(5, ts.marshal(), always=True)
+        w.string(6, chain_id)
+        return proto.delimited(w.out())
+
+    bids = [BlockID(),
+            BlockID(hash=b"\x07" * 32,
+                    part_set_header=PartSetHeader(total=2, hash=b"\x08" * 32))]
+    cases = []
+    for h in (1, 77, 300):
+        for r in (0, 5):
+            for vt in (vmod.PREVOTE_TYPE, vmod.PRECOMMIT_TYPE):
+                for bid in bids:
+                    for ts in (Time(0, 0), Time(1_700_000_000, 999)):
+                        cases.append(("chain-%d" % (h % 2), vt, h, r, bid, ts))
+    vmod._CV_TEMPLATES.clear()
+    for case in cases * 2:  # second pass hits the cache
+        assert vmod.canonical_vote_bytes(*case) == fresh(*case), case
+    # force eviction mid-stream and keep verifying
+    vmod._CV_TEMPLATES.clear()
+    for case in cases:
+        assert vmod.canonical_vote_bytes(*case) == fresh(*case)
