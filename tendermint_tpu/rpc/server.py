@@ -78,19 +78,41 @@ class RPCServer:
                 self._dispatch(method, params, id_=-1)
 
             def do_POST(self):
-                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    self._send(b"bad Content-Length", code=400)
+                    return
+                if length < 0:  # rfile.read(-1) would block to EOF
+                    self._send(b"bad Content-Length", code=400)
+                    return
                 body = self.rfile.read(length)
                 try:
                     req = json.loads(body)
-                except json.JSONDecodeError:
+                except ValueError:  # bad JSON or non-UTF-8 bytes
                     self._send(_rpc_response(0, error=RPCError(-32700, "Parse error")))
                     return
                 if isinstance(req, list):
+                    if not req:
+                        # JSON-RPC 2.0 §6: an empty batch gets a single
+                        # Invalid Request error object, not an array
+                        self._send(_rpc_response(
+                            0, error=RPCError(-32600, "Invalid Request")))
+                        return
                     out = []
                     for r in req:
+                        if not isinstance(r, dict):
+                            out.append(json.loads(_rpc_response(
+                                0, error=RPCError(-32600, "Invalid Request"))))
+                            continue
                         out.append(json.loads(self._call(
                             r.get("method", ""), r.get("params", {}), r.get("id", 0))))
                     self._send(json.dumps(out).encode())
+                    return
+                if not isinstance(req, dict):
+                    # null / scalar / string bodies are valid JSON but not
+                    # JSON-RPC requests (spec §4: request must be an object)
+                    self._send(_rpc_response(0, error=RPCError(-32600, "Invalid Request")))
                     return
                 self._dispatch(req.get("method", ""), req.get("params", {}),
                                req.get("id", 0))
@@ -99,9 +121,13 @@ class RPCServer:
                 self._send(self._call(method, params, id_))
 
             def _call(self, method, params, id_) -> bytes:
-                fn = rpc_core.ROUTES.get(method)
+                # method/id straight from attacker JSON: method may be any
+                # JSON value (an unhashable one would blow up dict.get)
+                fn = (rpc_core.ROUTES.get(method)
+                      if isinstance(method, str) else None)
                 if fn is None:
-                    return _rpc_response(id_, error=RPCError(-32601, "Method not found", method))
+                    return _rpc_response(id_, error=RPCError(
+                        -32601, "Method not found", str(method)))
                 try:
                     result = fn(env, **(params or {}))
                     return _rpc_response(id_, result=result)
@@ -150,9 +176,21 @@ class RPCServer:
                             req = json.loads(msg)
                         except json.JSONDecodeError:
                             continue
+                        if not isinstance(req, dict):
+                            ws_send(_rpc_response(
+                                0, error=RPCError(-32600, "Invalid Request")))
+                            continue
                         method = req.get("method", "")
-                        params = req.get("params", {}) or {}
+                        params = req.get("params", {})
                         id_ = req.get("id", 0)
+                        if params is None:
+                            params = {}
+                        if not isinstance(params, dict):
+                            # same verdict the HTTP path gives bad params;
+                            # silently coercing would subscribe-to-all
+                            ws_send(_rpc_response(id_, error=RPCError(
+                                -32602, "Invalid params")))
+                            continue
                         if method == "subscribe":
                             query = params.get("query", "")
                             sub = env.event_bus.subscribe(subscriber, query)
@@ -170,7 +208,8 @@ class RPCServer:
                             env.event_bus.unsubscribe_all(subscriber)
                             ws_send(_rpc_response(id_, result={}))
                         else:
-                            fn = rpc_core.ROUTES.get(method)
+                            fn = (rpc_core.ROUTES.get(method)
+                                  if isinstance(method, str) else None)
                             if fn is None:
                                 ws_send(_rpc_response(id_, error=RPCError(-32601, "Method not found")))
                             else:
