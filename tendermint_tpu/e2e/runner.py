@@ -342,7 +342,18 @@ class Runner:
             except Exception:  # noqa: BLE001
                 pass
             time.sleep(0.5)
-        raise TimeoutError("joined node never state-synced to the tip")
+        tail = ""
+        try:
+            with open(os.path.join(self.workdir, f"node{idx}.log"), "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                fh.seek(max(0, fh.tell() - 4096))
+                raw = fh.read().decode("utf-8", "replace")
+            tail = "\n".join(raw.splitlines()[-12:])
+        except OSError:
+            pass
+        raise TimeoutError(
+            "joined node never state-synced to the tip; joiner log tail:\n"
+            + tail)
 
     def stop(self) -> None:
         for i, proc in self.procs.items():

@@ -194,7 +194,8 @@ class Node:
             syncer = Syncer(
                 self.proxy_app.snapshot, self._make_state_provider(),
                 chunk_request_timeout_s=config.statesync.chunk_request_timeout_s,
-                chunk_fetchers=config.statesync.chunk_fetchers)
+                chunk_fetchers=config.statesync.chunk_fetchers,
+                logger=logger)
         # Reactor is registered unconditionally: every node SERVES snapshots
         # from its app (reference: node.go:839 statesync.NewReactor).
         self.statesync_reactor = StateSyncReactor(self.proxy_app.snapshot, syncer)

@@ -124,12 +124,19 @@ class Syncer:
 
     def sync(self, snapshot: Snapshot):
         """Restore one snapshot (reference: syncer.go:241 Sync)."""
+        if self.logger:
+            self.logger.info("state sync: attempting snapshot",
+                             height=snapshot.height, format=snapshot.format,
+                             chunks=snapshot.chunks)
         # 1. Trusted app hash for this height MUST exist before offering
         #    (reference: syncer.go:259 -- never feed the app unverified data).
         app_hash = self.state_provider.app_hash(snapshot.height)
 
         # 2. Offer to the app.
         self._offer_snapshot(snapshot, app_hash)
+        if self.logger:
+            self.logger.info("state sync: snapshot accepted, fetching chunks",
+                             height=snapshot.height)
 
         # 3. Fetch + apply chunks.
         with self._mtx:

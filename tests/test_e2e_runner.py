@@ -46,7 +46,10 @@ def test_e2e_statesync_join(tmp_path):
     try:
         r.load()
         r.perturb_and_wait(timeout_s=180)
-        idx = r.join_statesync_node(timeout_s=150)
+        # generous: the joiner subprocess pays a cold JAX import on the
+        # 1-core CI host, and any concurrent load stretches it (this
+        # deadline only matters when the host is contended)
+        idx = r.join_statesync_node(timeout_s=300)
         st = r._rpc(idx, "status", {})
         # bootstrapped mid-chain: no genesis replay
         assert int(st["sync_info"]["earliest_block_height"]) > 1
