@@ -11,6 +11,7 @@ always insertion-ordered, mirroring the clist walk the reactors do.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass, field as dc_field
 
@@ -52,6 +53,7 @@ class MempoolTx:
     sender: str = ""
     seq: int = 0
     senders: set = dc_field(default_factory=set)  # peer ids that sent it
+    time: float = 0.0  # wall clock at entry (TTL eviction)
 
 
 class TxCache:
@@ -88,7 +90,8 @@ class Mempool:
                  max_txs_bytes: int = 1024 * 1024 * 1024,
                  cache_size: int = 10000, max_tx_bytes: int = 1024 * 1024,
                  keep_invalid_txs_in_cache: bool = False,
-                 recheck: bool = True):
+                 recheck: bool = True,
+                 ttl_duration_s: float = 0.0, ttl_num_blocks: int = 0):
         self.app = app  # proxy.AppConnMempool-like
         self.version = version
         self.max_txs = max_txs
@@ -96,6 +99,10 @@ class Mempool:
         self.max_tx_bytes = max_tx_bytes
         self.keep_invalid = keep_invalid_txs_in_cache
         self.recheck = recheck
+        # 0 disables each bound (reference: mempool/v1/mempool.go
+        # purgeExpiredTxs; config.toml ttl-duration / ttl-num-blocks)
+        self.ttl_duration_s = ttl_duration_s
+        self.ttl_num_blocks = ttl_num_blocks
 
         self.cache = TxCache(cache_size)
         self._txs: OrderedDict[bytes, MempoolTx] = OrderedDict()  # key -> tx
@@ -156,7 +163,8 @@ class Mempool:
                 self._seq += 1
                 mtx = MempoolTx(tx=tx, height=self._height,
                                 gas_wanted=res.gas_wanted, priority=res.priority,
-                                sender=res.sender, seq=self._seq)
+                                sender=res.sender, seq=self._seq,
+                                time=time.monotonic())
                 if sender_peer:
                     mtx.senders.add(sender_peer)
                 self._txs[tx_key(tx)] = mtx
@@ -215,10 +223,32 @@ class Mempool:
             m = self._txs.pop(k, None)
             if m is not None:
                 self._txs_bytes -= len(m.tx)
+        self._purge_expired(height)
         if self.recheck and self._txs:
             self._recheck_txs()
         if self._txs:
             self._notify_txs_available()
+
+    def _purge_expired(self, height: int) -> None:
+        """Evict txs past their TTL (reference: mempool/v1/mempool.go
+        purgeExpiredTxs): ttl_num_blocks bounds blocks-in-pool,
+        ttl_duration_s bounds wall-clock age; either at 0 is disabled.
+        Expired txs leave the cache too, so a later resubmission is not
+        rejected as a duplicate. Caller must hold the lock."""
+        if not self.ttl_num_blocks and not self.ttl_duration_s:
+            return
+        now = time.monotonic()
+        for k in list(self._txs.keys()):
+            m = self._txs[k]
+            expired = (
+                (self.ttl_num_blocks > 0
+                 and height - m.height > self.ttl_num_blocks)
+                or (self.ttl_duration_s > 0
+                    and now - m.time > self.ttl_duration_s))
+            if expired:
+                del self._txs[k]
+                self._txs_bytes -= len(m.tx)
+                self.cache.remove(m.tx)
 
     def _recheck_txs(self) -> None:
         """reference: mempool/v0/clist_mempool.go:641-664."""

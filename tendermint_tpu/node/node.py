@@ -126,6 +126,8 @@ class Node:
             max_tx_bytes=config.mempool.max_tx_bytes,
             keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
             recheck=config.mempool.recheck,
+            ttl_duration_s=config.mempool.ttl_duration_s,
+            ttl_num_blocks=config.mempool.ttl_num_blocks,
         )
 
         # evidence pool

@@ -204,3 +204,48 @@ def test_state_store_abci_responses_roundtrip():
     out = ss.load_abci_responses(9)
     assert out.deliver_txs[0].data == b"ok"
     assert out.deliver_txs[1].code == 7
+
+
+def test_mempool_ttl_num_blocks_eviction():
+    """ttl-num-blocks: a tx older than N blocks is purged on update and
+    leaves the cache so it can be resubmitted (reference:
+    mempool/v1/mempool.go purgeExpiredTxs)."""
+    app = KVStoreApplication()
+    mp = Mempool(app, ttl_num_blocks=2)
+    mp.check_tx(b"old=1")  # enters at height 0
+    mp.lock(); mp.update(1, []); mp.unlock()
+    mp.lock(); mp.update(2, []); mp.unlock()
+    assert mp.size() == 1  # age exactly 2: strict > keeps it one more block
+    mp.check_tx(b"young=1")  # enters at height 2
+    mp.lock(); mp.update(3, []); mp.unlock()
+    assert [m.tx for m in mp.iter_txs()] == [b"young=1"]  # old age 3 > 2
+    # expired tx left the cache: resubmission is accepted, not ErrTxInCache
+    assert mp.check_tx(b"old=1").is_ok()
+    assert mp.size() == 2
+
+
+def test_mempool_ttl_duration_eviction(monkeypatch):
+    import time as _time
+
+    from tendermint_tpu.mempool import mempool as mpmod
+
+    app = KVStoreApplication()
+    mp = Mempool(app, ttl_duration_s=10.0)
+    t0 = _time.monotonic()
+    monkeypatch.setattr(mpmod.time, "monotonic", lambda: t0)
+    mp.check_tx(b"aging=1")
+    mp.check_tx(b"fresh=1")
+    # first tx is now 11s old (> 10), second only 5s (re-stamped younger)
+    mp._txs[mpmod.tx_key(b"fresh=1")].time = t0 + 6
+    monkeypatch.setattr(mpmod.time, "monotonic", lambda: t0 + 11)
+    mp.lock(); mp.update(1, []); mp.unlock()
+    assert [m.tx for m in mp.iter_txs()] == [b"fresh=1"]
+
+
+def test_mempool_ttl_disabled_by_default():
+    app = KVStoreApplication()
+    mp = Mempool(app)
+    mp.check_tx(b"keep=1")
+    for h in range(1, 8):
+        mp.lock(); mp.update(h, []); mp.unlock()
+    assert mp.size() == 1
