@@ -45,7 +45,7 @@ def test_e2e_statesync_join(tmp_path):
     r.start()
     try:
         r.load()
-        r.perturb_and_wait(timeout_s=180)
+        r.perturb_and_wait(timeout_s=240)
         # generous: the joiner subprocess pays a cold JAX import on the
         # 1-core CI host, and any concurrent load stretches it (this
         # deadline only matters when the host is contended)
