@@ -582,6 +582,70 @@ def broadcast_evidence(env, evidence):
     return {"hash": ev.hash().hex()}
 
 
+# --- unsafe control routes (reference: rpc/core/routes.go:51
+# AddUnsafeRoutes, net.go UnsafeDialSeeds/UnsafeDialPeers,
+# mempool.go UnsafeFlushMempool). The reference registers these only when
+# config.RPC.Unsafe; here they are always routed but refuse unless
+# rpc.unsafe is set — same reachable surface, clearer error. ------------
+
+
+def _require_unsafe(env) -> None:
+    cfg = getattr(getattr(env.node, "config", None), "rpc", None)
+    if cfg is None or not cfg.unsafe:
+        raise ValueError(
+            "unsafe RPC routes are disabled (set rpc.unsafe = true)")
+
+
+def _validated_addrs(addrs, what: str) -> list:
+    """The reference parses every address up front and errors before any
+    dialing (net.go UnsafeDialPeers -> NewNetAddressStrings)."""
+    if not isinstance(addrs, list) or not addrs:
+        raise ValueError(f"no {what} provided (expected a non-empty list)")
+    for a in addrs:
+        if (not isinstance(a, str) or "@" not in a
+                or ":" not in a.rsplit("@", 1)[1]):
+            raise ValueError(f"invalid {what[:-1]} address {a!r} "
+                             "(expected id@host:port)")
+    return addrs
+
+
+def _dial_async(env, addrs: list, persistent: bool) -> None:
+    """Dial in the background — a handler thread must not block for
+    N x dial+handshake timeouts (reference dials via DialPeersAsync)."""
+    import threading
+
+    def run():
+        for a in addrs:
+            env.node.switch.dial_peer(a, persistent=persistent)
+
+    threading.Thread(target=run, name="rpc-dial", daemon=True).start()
+
+
+def dial_seeds(env, seeds=None):
+    _require_unsafe(env)
+    _dial_async(env, _validated_addrs(seeds, "seeds"), persistent=False)
+    return {"log": "dialing seeds in progress; see /net_info"}
+
+
+def dial_peers(env, peers=None, persistent=False, unconditional=False,
+               private=False):
+    _require_unsafe(env)
+    if unconditional or private:
+        # Reference semantics (net.go:41-66) mark peer ids unconditional/
+        # private in the switch+PEX; this build has no such registry, and
+        # silently ignoring the flags would mislead callers.
+        raise ValueError("unconditional/private peer flags are not supported")
+    _dial_async(env, _validated_addrs(peers, "peers"),
+                persistent=bool(persistent))
+    return {"log": "dialing peers in progress; see /net_info"}
+
+
+def unsafe_flush_mempool(env):
+    _require_unsafe(env)
+    env.node.mempool.flush()
+    return {}
+
+
 ROUTES = {
     "health": health,
     "status": status,
@@ -612,4 +676,8 @@ ROUTES = {
     "abci_query": abci_query,
     "abci_info": abci_info,
     "broadcast_evidence": broadcast_evidence,
+    # unsafe control routes: refuse unless rpc.unsafe (routes.go:51)
+    "dial_seeds": dial_seeds,
+    "dial_peers": dial_peers,
+    "unsafe_flush_mempool": unsafe_flush_mempool,
 }

@@ -135,3 +135,44 @@ def test_subscribe_streams_new_blocks(client):
         assert "block" in ev["data"]["value"] or ev["data"]
     finally:
         gen.close()
+
+
+def test_unsafe_routes_refused_by_default(client):
+    """reference: rpc/core/routes.go:51 AddUnsafeRoutes — control routes
+    are unreachable unless rpc.unsafe is configured."""
+    for call in (lambda: client._call("unsafe_flush_mempool", {}),
+                 lambda: client._call("dial_seeds", {"seeds": ["x@1.2.3.4:1"]}),
+                 lambda: client._call("dial_peers", {"peers": ["x@1.2.3.4:1"]})):
+        with pytest.raises(RPCClientError, match="unsafe"):
+            call()
+
+
+def test_unsafe_flush_mempool_when_enabled(live_node):
+    live_node.config.rpc.unsafe = True
+    try:
+        c = LocalClient(live_node)
+        c.broadcast_tx_sync(b"flushme=1")
+        # tx may commit quickly; flush must succeed and empty the pool
+        assert c._call("unsafe_flush_mempool", {}) == {}
+        assert live_node.mempool.size() == 0
+        with pytest.raises(RPCClientError, match="no seeds"):
+            c._call("dial_seeds", {"seeds": []})
+    finally:
+        live_node.config.rpc.unsafe = False
+
+
+def test_unsafe_dial_validation(live_node):
+    """Addresses validate up front (reference: net.go parses before
+    dialing); unsupported flags error instead of silently no-oping."""
+    live_node.config.rpc.unsafe = True
+    try:
+        c = LocalClient(live_node)
+        with pytest.raises(RPCClientError, match="invalid"):
+            c._call("dial_peers", {"peers": ["not-an-address"]})
+        with pytest.raises(RPCClientError, match="non-empty list"):
+            c._call("dial_seeds", {"seeds": "id@1.2.3.4:1"})  # string, not list
+        with pytest.raises(RPCClientError, match="not supported"):
+            c._call("dial_peers", {"peers": ["a" * 40 + "@1.2.3.4:1"],
+                                   "private": True})
+    finally:
+        live_node.config.rpc.unsafe = False
