@@ -35,10 +35,12 @@ def t(sec):
 
 
 def _mk_keys(n, power=10, seed=0):
+    """power: one int for all validators, or a per-validator list."""
+    powers = power if isinstance(power, (list, tuple)) else [power] * n
     pairs = []
     for i in range(n):
         priv = ed25519.gen_priv_key(bytes([(seed * 37 + i + 1) % 256]) * 32)
-        pairs.append((priv, Validator.new(priv.pub_key(), power)))
+        pairs.append((priv, Validator.new(priv.pub_key(), powers[i])))
     vs = ValidatorSet([v for _, v in pairs])
     by_addr = {v.address: p for p, v in pairs}
     privs = [by_addr[v.address] for v in vs.validators]
@@ -413,3 +415,41 @@ def test_light_proxy_serves_verified_data(tmp_path):
         if proxy is not None:
             proxy.stop()
         node.stop()
+
+
+def test_exhaustive_threshold_boundaries():
+    """Enumerate EVERY signer subset at several set sizes/powers and pin
+    the exact acceptance boundaries of the two light-client verifies:
+    verify_commit_light needs voting power > 2/3 of the set
+    (types/validator_set.go:722), verify_commit_light_trusting at level
+    (1,3) needs > 1/3 of the TRUSTED set's power (:772-830). The batched
+    kernel path must agree with pure arithmetic on all 2^n subsets."""
+    import itertools
+
+    from tendermint_tpu.types.validator_set import ErrNotEnoughVotingPowerSigned
+
+    for seed, powers in enumerate(
+            ([10, 10, 10, 10], [1, 2, 3, 10], [5, 5, 5, 5, 5])):
+        n = len(powers)
+        privs, vals = _mk_keys(n, power=powers, seed=seed + 9)
+        header = _mk_header(7, 800, vals, vals)
+        total = vals.total_voting_power()
+        for mask in itertools.product([0, 1], repeat=n):
+            absent = tuple(i for i, m in enumerate(mask) if not m)
+            commit = _sign_commit(header, vals, privs, skip=absent)
+            signed = sum(v.voting_power
+                         for v, m in zip(vals.validators, mask) if m)
+
+            def expect(ok_fn, needed_gt):
+                try:
+                    ok_fn()
+                    accepted = True
+                except ErrNotEnoughVotingPowerSigned:
+                    accepted = False
+                want = signed * 3 > needed_gt  # strict >
+                assert accepted == want, (powers, mask, signed)
+
+            expect(lambda: vals.verify_commit_light(
+                CHAIN_ID, commit.block_id, 7, commit), 2 * total)
+            expect(lambda: vals.verify_commit_light_trusting(
+                CHAIN_ID, commit, (1, 3)), total)
