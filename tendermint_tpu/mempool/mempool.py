@@ -144,7 +144,11 @@ class Mempool:
         if self.pre_check is not None:
             self.pre_check(tx)
         with self._mtx:
-            if len(self._txs) >= self.max_txs or self._txs_bytes + len(tx) > self.max_txs_bytes:
+            full = (len(self._txs) >= self.max_txs
+                    or self._txs_bytes + len(tx) > self.max_txs_bytes)
+            if full and self.version != "v1":
+                # v0 rejects when full; v1 may evict lower-priority txs
+                # AFTER the app has priced the newcomer (see below).
                 raise ErrMempoolIsFull(len(self._txs), self.max_txs,
                                        self._txs_bytes, self.max_txs_bytes)
         if not self.cache.push(tx):
@@ -160,6 +164,7 @@ class Mempool:
             self.post_check(tx, res)
         if res.is_ok():
             with self._mtx:
+                self._make_room_locked(tx, res.priority)
                 self._seq += 1
                 mtx = MempoolTx(tx=tx, height=self._height,
                                 gas_wanted=res.gas_wanted, priority=res.priority,
@@ -174,6 +179,38 @@ class Mempool:
             if not self.keep_invalid:
                 self.cache.remove(tx)
         return res
+
+    def _make_room_locked(self, tx: bytes, priority: int) -> None:
+        """v1 full-pool admission (reference: mempool/v1/mempool.go:505-577):
+        evict strictly-lower-priority txs, lowest first (ties: newest
+        first), until the newcomer fits; if the eligible victims can't make
+        enough room, reject it — and drop it from the dedup cache so a
+        later retry isn't refused as a duplicate."""
+        need_count = 1 if len(self._txs) >= self.max_txs else 0
+        need_bytes = max(0, self._txs_bytes + len(tx) - self.max_txs_bytes)
+        if not need_count and not need_bytes:
+            return
+        if self.version != "v1":
+            # v0 reached here only via a fill-up race between the unlocked
+            # pre-check and insertion: reject-when-full, never evict.
+            self.cache.remove(tx)
+            raise ErrMempoolIsFull(len(self._txs), self.max_txs,
+                                   self._txs_bytes, self.max_txs_bytes)
+        victims = [m for m in self._txs.values() if m.priority < priority]
+        if not victims or sum(len(v.tx) for v in victims) < need_bytes:
+            self.cache.remove(tx)
+            raise ErrMempoolIsFull(len(self._txs), self.max_txs,
+                                   self._txs_bytes, self.max_txs_bytes)
+        victims.sort(key=lambda m: (m.priority, -m.seq))
+        freed_bytes = freed_count = 0
+        for v in victims:
+            del self._txs[tx_key(v.tx)]
+            self._txs_bytes -= len(v.tx)
+            self.cache.remove(v.tx)
+            freed_bytes += len(v.tx)
+            freed_count += 1
+            if freed_bytes >= need_bytes and freed_count >= need_count:
+                break
 
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
         """reference: mempool/v0/clist_mempool.go:519-555; v1 orders by

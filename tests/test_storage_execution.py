@@ -5,7 +5,11 @@ import pytest
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.abci.kvstore import KVStoreApplication
 from tendermint_tpu.crypto import ed25519
-from tendermint_tpu.mempool.mempool import ErrTxInCache, Mempool
+from tendermint_tpu.mempool.mempool import (
+    ErrMempoolIsFull,
+    ErrTxInCache,
+    Mempool,
+)
 from tendermint_tpu.privval.file_pv import DoubleSignError, FilePV, MockPV
 from tendermint_tpu.state.execution import BlockExecutor
 from tendermint_tpu.state.state import make_genesis_state
@@ -249,3 +253,38 @@ def test_mempool_ttl_disabled_by_default():
     for h in range(1, 8):
         mp.lock(); mp.update(h, []); mp.unlock()
     assert mp.size() == 1
+
+
+def test_mempool_v1_priority_eviction_when_full():
+    """v1 full-pool admission (reference: mempool/v1/mempool.go:505-577):
+    a higher-priority arrival evicts the lowest-priority txs (ties: newest
+    first); an arrival no better than everything resident is rejected and
+    un-cached so it can be retried later. v0 keeps reject-when-full."""
+    class PrioApp(KVStoreApplication):
+        def check_tx(self, req):
+            # priority = numeric suffix after '~'
+            return abci.ResponseCheckTx(code=0,
+                                        priority=int(req.tx.split(b"~")[1]))
+
+    mp = Mempool(PrioApp(), version="v1", max_txs=3)
+    mp.check_tx(b"a~5")
+    mp.check_tx(b"b~1")
+    mp.check_tx(b"c~3")
+    # full; priority 4 > {1,3}: evicts the single lowest (b~1)
+    assert mp.check_tx(b"d~4").is_ok()
+    assert sorted(m.tx for m in mp.iter_txs()) == [b"a~5", b"c~3", b"d~4"]
+    # evicted tx left the cache: immediate retry is not ErrTxInCache
+    # (still full, and priority 1 beats nothing -> full again)
+    with pytest.raises(ErrMempoolIsFull):
+        mp.check_tx(b"b~1")
+    with pytest.raises(ErrMempoolIsFull):
+        mp.check_tx(b"b~1")  # NOT ErrTxInCache: reject removed it from cache
+    # another arrival evicts the current lowest priority (c~3)
+    assert mp.check_tx(b"e~9").is_ok()
+    assert sorted(m.tx for m in mp.iter_txs()) == [b"a~5", b"d~4", b"e~9"]
+
+    # v0: reject-when-full regardless of priority
+    mp0 = Mempool(PrioApp(), version="v0", max_txs=1)
+    mp0.check_tx(b"x~1")
+    with pytest.raises(ErrMempoolIsFull):
+        mp0.check_tx(b"y~9")
