@@ -161,7 +161,14 @@ class Mempool:
 
         res = self.app.check_tx(abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW))
         if self.post_check is not None:
-            self.post_check(tx, res)
+            try:
+                self.post_check(tx, res)
+            except Exception:
+                # post-check failure = invalid tx (reference resCbFirstTime):
+                # it must not stay cached unless keep_invalid says so
+                if not self.keep_invalid:
+                    self.cache.remove(tx)
+                raise
         if res.is_ok():
             with self._mtx:
                 self._make_room_locked(tx, res.priority)
@@ -245,9 +252,16 @@ class Mempool:
             return [m.tx for m in entries[:n]]
 
     def update(self, height: int, txs: list[bytes],
-               deliver_tx_responses: list[abci.ResponseDeliverTx] | None = None) -> None:
+               deliver_tx_responses: list[abci.ResponseDeliverTx] | None = None,
+               pre_check=None, post_check=None) -> None:
         """Remove committed txs; recheck the rest (reference:
-        mempool/v0/clist_mempool.go:577-639). Caller must hold the lock."""
+        mempool/v0/clist_mempool.go:577-639). Caller must hold the lock.
+        pre_check/post_check, when given, replace the admission filters —
+        they derive from the NEW state (state/tx_filter.py)."""
+        if pre_check is not None:
+            self.pre_check = pre_check
+        if post_check is not None:
+            self.post_check = post_check
         self._height = height
         self._notified_available = False
         for i, tx in enumerate(txs):
@@ -288,13 +302,21 @@ class Mempool:
                 self.cache.remove(m.tx)
 
     def _recheck_txs(self) -> None:
-        """reference: mempool/v0/clist_mempool.go:641-664."""
+        """reference: mempool/v0/clist_mempool.go:641-664; the post-check
+        filter applies on recheck too (resCbRecheck -> postCheck), so a
+        max_gas tightened by the applied block evicts over-priced txs."""
         for k in list(self._txs.keys()):
             m = self._txs[k]
             res = self.app.check_tx(
                 abci.RequestCheckTx(tx=m.tx, type=abci.CHECK_TX_TYPE_RECHECK)
             )
-            if not res.is_ok():
+            ok = res.is_ok()
+            if ok and self.post_check is not None:
+                try:
+                    self.post_check(m.tx, res)
+                except Exception:  # noqa: BLE001 - filter verdict, not error
+                    ok = False
+            if not ok:
                 del self._txs[k]
                 self._txs_bytes -= len(m.tx)
                 if not self.keep_invalid:

@@ -129,6 +129,13 @@ class Node:
             ttl_duration_s=config.mempool.ttl_duration_s,
             ttl_num_blocks=config.mempool.ttl_num_blocks,
         )
+        # admission filters from the current state (reference:
+        # node.go:383,404 WithPreCheck/WithPostCheck; refreshed per block
+        # by BlockExecutor._commit)
+        from tendermint_tpu.state.tx_filter import tx_post_check, tx_pre_check
+
+        self.mempool.pre_check = tx_pre_check(state)
+        self.mempool.post_check = tx_post_check(state)
 
         # evidence pool
         from tendermint_tpu.evidence.pool import EvidencePool

@@ -143,14 +143,21 @@ class BlockExecutor:
 
     def _commit(self, state: State, block: Block, abci_responses: ABCIResponses):
         """reference: state/execution.go:211-257: flush mempool, app Commit,
-        mempool Update."""
+        mempool Update (with admission filters rebuilt from the new state)."""
         if self.mempool is not None:
             self.mempool.lock()
         try:
             res = self.app.commit()
             if self.mempool is not None:
+                from tendermint_tpu.state.tx_filter import (
+                    tx_post_check,
+                    tx_pre_check,
+                )
+
                 self.mempool.update(
                     block.header.height, block.data.txs, abci_responses.deliver_txs,
+                    pre_check=tx_pre_check(state),
+                    post_check=tx_post_check(state),
                 )
         finally:
             if self.mempool is not None:

@@ -1,0 +1,52 @@
+"""Mempool admission filters derived from consensus state
+(reference: state/tx_filter.go, mempool/mempool.go:111-141).
+
+`tx_pre_check(state)` bounds a single tx to the block's maximum data size
+(MaxDataBytesNoEvidence: the whole block budget minus header/commit
+overhead for the current validator count); `tx_post_check(state)` bounds
+the gas the app priced a tx at to the block's max_gas (-1 disables). Both
+are rebuilt from the NEW state after every applied block, exactly like
+the reference's mempool.Update(..., preCheck, postCheck) plumbing.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.mempool.mempool import ErrPreCheck
+from tendermint_tpu.types.tx import total_tx_bytes
+
+
+def max_data_bytes_no_evidence(max_bytes: int, num_vals: int) -> int:
+    """reference: types/block.go:301 MaxDataBytesNoEvidence."""
+    from tendermint_tpu.state.execution import max_data_bytes
+
+    return max_data_bytes(max_bytes, 0, num_vals)
+
+
+def tx_pre_check(state):
+    limit = max_data_bytes_no_evidence(
+        state.consensus_params.block.max_bytes, state.validators.size())
+
+    def check(tx: bytes) -> None:
+        # proto size of Data{txs: [tx]} (reference: types/tx.go:156
+        # ComputeProtoSizeForTxs)
+        size = total_tx_bytes([tx])
+        if size > limit:
+            raise ErrPreCheck(f"tx size is too big: {size}, max: {limit}")
+
+    return check
+
+
+def tx_post_check(state):
+    max_gas = state.consensus_params.block.max_gas
+
+    def check(tx: bytes, res) -> None:
+        if max_gas == -1:
+            return
+        if res.gas_wanted < 0:
+            raise ErrPreCheck(f"gas wanted {res.gas_wanted} is negative")
+        if res.gas_wanted > max_gas:
+            raise ErrPreCheck(
+                f"gas wanted {res.gas_wanted} is greater than "
+                f"max gas {max_gas}")
+
+    return check

@@ -288,3 +288,56 @@ def test_mempool_v1_priority_eviction_when_full():
     mp0.check_tx(b"x~1")
     with pytest.raises(ErrMempoolIsFull):
         mp0.check_tx(b"y~9")
+
+
+def test_tx_filters_from_consensus_state():
+    """state/tx_filter.py: pre-check bounds tx size to the block data
+    budget, post-check bounds gas to block.max_gas; both typed as
+    ErrPreCheck and un-cached so a retry isn't a cache hit (reference:
+    state/tx_filter.go, mempool/mempool.go:111-141)."""
+    from dataclasses import replace as dc_replace
+
+    from tendermint_tpu.mempool.mempool import ErrPreCheck
+    from tendermint_tpu.state.tx_filter import tx_post_check, tx_pre_check
+    from tendermint_tpu.types.params import BlockParams
+
+    gd, _ = _genesis(1)
+    state = make_genesis_state(gd)
+
+    class GasApp(KVStoreApplication):
+        def check_tx(self, req):
+            return abci.ResponseCheckTx(code=0, gas_wanted=len(req.tx))
+
+    # post-check: max_gas=5 rejects a 6-byte (gas 6) tx, accepts gas 5
+    state5 = dc_replace(
+        state, consensus_params=dc_replace(
+            state.consensus_params, block=BlockParams(max_gas=5)))
+    mp = Mempool(GasApp())
+    mp.post_check = tx_post_check(state5)
+    assert mp.check_tx(b"five!").is_ok()
+    with pytest.raises(ErrPreCheck, match="max gas"):
+        mp.check_tx(b"sixsix")
+    assert mp.check_tx(b"5char").is_ok()  # gas exactly at the bound passes
+    # rejected tx is NOT cached: same bytes later raise the same filter
+    # error, not ErrTxInCache
+    with pytest.raises(ErrPreCheck, match="max gas"):
+        mp.check_tx(b"sixsix")
+
+    # pre-check: a tiny block budget rejects big txs before the app runs
+    tiny = dc_replace(
+        state, consensus_params=dc_replace(
+            state.consensus_params, block=BlockParams(max_bytes=1000)))
+    mp2 = Mempool(KVStoreApplication())
+    mp2.pre_check = tx_pre_check(tiny)
+    assert mp2.check_tx(b"ok=1").is_ok()
+    with pytest.raises(ErrPreCheck, match="too big"):
+        mp2.check_tx(b"z" * 900)
+
+    # recheck applies post-check: tightening max_gas evicts resident txs
+    mp3 = Mempool(GasApp())
+    mp3.check_tx(b"sevennn")  # gas 7, admitted (no filter yet)
+    mp3.lock()
+    mp3.update(1, [], pre_check=tx_pre_check(state5),
+               post_check=tx_post_check(state5))
+    mp3.unlock()
+    assert mp3.size() == 0  # gas 7 > 5: evicted on recheck
