@@ -1,0 +1,150 @@
+"""Host-side batched curve25519 verification: ctypes bindings for
+csrc/curve25519_host.c.
+
+This is the CPU half of the adaptive kernel/scalar crossover
+(crypto/batch.py): the TPU on this class of host sits behind a tunnel with a
+~90 ms round-trip sync floor, so batches below a few thousand signatures are
+verified here — serial Straus/wNAF for a handful, a Pippenger
+random-linear-combination batch check above that — instead of paying the
+floor.  Accept/reject is byte-identical to the scalar reference
+(crypto/ed25519.py verify / crypto/sr25519.py verify; reference semantics
+crypto/ed25519/ed25519.go:148, crypto/sr25519/pubkey.go:10): the RLC check
+falls back to per-item serial verification whenever the batch equation
+fails, so callers always observe serial decisions.
+
+Build mirrors ops/chash.py: lazy g++, content-hashed .so name (a stale
+binary can never load silently; csrc/*.so is gitignored).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+_SRC = os.path.abspath(os.path.join(_CSRC, "curve25519_host.c"))
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _lib_path() -> str:
+    h = hashlib.sha256()
+    with open(_SRC, "rb") as f:
+        h.update(f.read())
+    return os.path.abspath(
+        os.path.join(_CSRC, f"libcurvehost-{h.hexdigest()[:12]}.so"))
+
+
+def _build(lib_path: str) -> bool:
+    tmp = lib_path + f".tmp{os.getpid()}"
+    for flags in (["-march=native"], []):
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-x", "c",
+               _SRC, "-o", tmp] + flags
+        try:
+            r = subprocess.run(cmd, capture_output=True, timeout=180)
+            if r.returncode == 0:
+                os.replace(tmp, lib_path)  # atomic vs concurrent builders
+                return True
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+    return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("TM_TPU_DISABLE_CHOST") == "1":
+            return None
+        path = _lib_path()
+        if not os.path.exists(path) and not _build(path):
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.ed25519h_verify.argtypes = [
+            ctypes.c_long, _U8P, _U8P, _U8P, _U8P, _U8P, _U8P,
+            ctypes.c_int, _U8P]
+        lib.ed25519h_verify.restype = None
+        lib.sr25519h_verify.argtypes = lib.ed25519h_verify.argtypes
+        lib.sr25519h_verify.restype = None
+        lib.ed25519h_selftest.restype = ctypes.c_int
+        if lib.ed25519h_selftest() != 1:
+            return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _u8(a: np.ndarray) -> "ctypes._Pointer":
+    return a.ctypes.data_as(_U8P)
+
+
+def _as_rows(x, n: int) -> np.ndarray:
+    a = np.ascontiguousarray(x, dtype=np.uint8)
+    assert a.shape == (n, 32), a.shape
+    return a
+
+
+def ed25519_verify(pubs: np.ndarray, h32: np.ndarray, s32: np.ndarray,
+                   r32: np.ndarray, valid: np.ndarray,
+                   mode: int = 2) -> np.ndarray:
+    """Batched ed25519 verify on host.  pubs/h32/s32/r32: (n, 32) uint8
+    (h32 = SHA-512(R||A||M) mod L, little-endian); valid: (n,) bool from the
+    caller's size prechecks.  mode 0=serial, 1=RLC, 2=auto.  -> (n,) bool."""
+    lib = _load()
+    assert lib is not None
+    n = len(valid)
+    if n == 0:
+        return np.zeros((0,), dtype=bool)
+    pubs = _as_rows(pubs, n)
+    h32 = _as_rows(h32, n)
+    s32 = _as_rows(s32, n)
+    r32 = _as_rows(r32, n)
+    v = np.ascontiguousarray(valid, dtype=np.uint8)
+    seed = np.frombuffer(os.urandom(32), dtype=np.uint8)
+    out = np.zeros((n,), dtype=np.uint8)
+    lib.ed25519h_verify(n, _u8(pubs), _u8(h32), _u8(s32), _u8(r32), _u8(v),
+                        _u8(seed), mode, _u8(out))
+    return out.astype(bool)
+
+
+def sr25519_verify(pubs: np.ndarray, c32: np.ndarray, s32: np.ndarray,
+                   r32: np.ndarray, valid: np.ndarray,
+                   mode: int = 2) -> np.ndarray:
+    """Batched sr25519 verify on host.  c32 = merlin challenge mod L
+    (from ops/sr25519_batch's C strobe transcripts); s32 = sig[32:] with the
+    schnorrkel marker bit already stripped; r32 = sig[:32]; valid covers
+    sizes AND the sig[63]&128 marker check."""
+    lib = _load()
+    assert lib is not None
+    n = len(valid)
+    if n == 0:
+        return np.zeros((0,), dtype=bool)
+    pubs = _as_rows(pubs, n)
+    c32 = _as_rows(c32, n)
+    s32 = _as_rows(s32, n)
+    r32 = _as_rows(r32, n)
+    v = np.ascontiguousarray(valid, dtype=np.uint8)
+    seed = np.frombuffer(os.urandom(32), dtype=np.uint8)
+    out = np.zeros((n,), dtype=np.uint8)
+    lib.sr25519h_verify(n, _u8(pubs), _u8(c32), _u8(s32), _u8(r32), _u8(v),
+                        _u8(seed), mode, _u8(out))
+    return out.astype(bool)
