@@ -92,7 +92,13 @@ class _KernelBatchVerifier(BatchVerifier):
         import importlib
 
         items, self._items = self._items, []
-        if len(items) < batch_min(self._batch_min_default):
+        from tendermint_tpu.ops import chost
+
+        if (len(items) < batch_min(self._batch_min_default)
+                and not chost.available()):
+            # Pure-Python scalar fallback only when the C host verifier is
+            # missing: with it, the ops dispatch routes ANY size to the host
+            # path below the measured crossover (VERDICT r4 item 1a).
             scalar = importlib.import_module(self._scalar_module)
             out = [scalar.verify(p, m, s) for (p, m, s) in items]
             return None, lambda _: (all(out), out)
@@ -210,11 +216,17 @@ def warmup(sizes: tuple[int, ...] = (64,), background: bool = True):
             from tendermint_tpu.crypto import ed25519
             from tendermint_tpu.ops import ed25519_batch
 
+            # Measure the host/kernel crossover first so the warm buckets
+            # below compile the path real batches will actually take.
+            ed25519_batch.calibrate_host_crossover()
             priv = ed25519.gen_priv_key(b"\x42" * 32)
             pub = priv.pub_key().bytes()
             sig = ed25519.sign(priv.data, b"warmup")
             for n in sizes:
-                ed25519_batch.verify_batch([(pub, b"warmup", sig)] * n)
+                # force_device: the point is compiling the kernel buckets,
+                # which the host route would otherwise absorb
+                ed25519_batch.verify_batch([(pub, b"warmup", sig)] * n,
+                                           force_device=True)
         except Exception:  # noqa: BLE001 - warmup must never kill a node
             return
 

@@ -200,6 +200,13 @@ class PubKey(_keys.PubKey):
         return self.data
 
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        # Production scalar path routes through the C host verifier
+        # (~100 us/sig vs ~2 ms pure Python); `verify()` above stays the
+        # pure-Python reference that kernels differential-test against.
+        from tendermint_tpu.ops import chost
+
+        if chost.available():
+            return chost.ed25519_verify_one(self.data, msg, sig)
         return verify(self.data, msg, sig)
 
     def equals(self, other) -> bool:

@@ -395,6 +395,12 @@ class PubKey(keys.PubKey):
         return self.data
 
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        # C host fast path (curve + strobe challenge in C); `verify()` above
+        # stays the pure-Python reference for differential tests.
+        from tendermint_tpu.ops import chost
+
+        if chost.available():
+            return chost.sr25519_verify_one(self.data, msg, sig)
         return verify(self.data, msg, sig)
 
     def equals(self, other) -> bool:

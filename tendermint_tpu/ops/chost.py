@@ -126,6 +126,39 @@ def ed25519_verify(pubs: np.ndarray, h32: np.ndarray, s32: np.ndarray,
     return out.astype(bool)
 
 
+def ed25519_verify_one(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Single-signature fast path for PubKey.verify_signature: ~100 us vs
+    the pure-Python reference's ~2 ms. Caller guarantees availability."""
+    import hashlib
+
+    if len(pub) != 32 or len(sig) != 64:
+        return False
+    L = 2**252 + 27742317777372353535851937790883648493
+    h = int.from_bytes(hashlib.sha512(sig[:32] + pub + msg).digest(),
+                       "little") % L
+    arr = np.frombuffer(pub + h.to_bytes(32, "little") + sig[32:] + sig[:32],
+                        dtype=np.uint8).reshape(4, 32)
+    return bool(ed25519_verify(arr[0:1], arr[1:2], arr[2:3], arr[3:4],
+                               np.ones((1,), bool), mode=0)[0])
+
+
+def sr25519_verify_one(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Single sr25519 fast path: C strobe challenge (ops/sr25519_batch) +
+    C curve verify. Caller guarantees availability."""
+    if len(pub) != 32 or len(sig) != 64:
+        return False
+    from tendermint_tpu.ops import sr25519_batch as srb
+
+    pubs = np.frombuffer(pub, dtype=np.uint8).reshape(1, 32)
+    r32 = np.frombuffer(sig[:32], dtype=np.uint8).reshape(1, 32)
+    s32 = np.frombuffer(sig[32:], dtype=np.uint8).reshape(1, 32).copy()
+    marker = bool(s32[0, 31] & 128)
+    s32[0, 31] &= 127
+    c32 = srb.challenges([msg], pubs, r32)
+    return bool(sr25519_verify(pubs, c32, s32, r32,
+                               np.array([marker]), mode=0)[0])
+
+
 def sr25519_verify(pubs: np.ndarray, c32: np.ndarray, s32: np.ndarray,
                    r32: np.ndarray, valid: np.ndarray,
                    mode: int = 2) -> np.ndarray:

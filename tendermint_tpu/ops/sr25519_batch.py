@@ -249,8 +249,6 @@ def dispatch_batch(items: list[tuple[bytes, bytes, bytes]]):
     if not items:
         return None, lambda _: np.zeros((0,), dtype=bool)
     n = len(items)
-    ks, key_idx, pub_ok = get_keyset([it[0] for it in items])
-    pub_ok = pub_ok & ks.valid[key_idx]
 
     sig_ok = np.fromiter(
         (len(it[2]) == srref.SIGNATURE_SIZE for it in items), dtype=bool, count=n)
@@ -262,14 +260,29 @@ def dispatch_batch(items: list[tuple[bytes, bytes, bytes]]):
     s32 = np.ascontiguousarray(sigs[:, 32:]).copy()
     marker_ok = (s32[:, 31] & 128) != 0  # schnorrkel v1 marker bit
     s32[:, 31] &= 127
+
+    pubs32, pub_size_ok = edb._normalize_pubs([it[0] for it in items])
+    pubs_arr = np.frombuffer(pubs32, dtype=np.uint8).reshape(n, 32)
+
+    if n < edb.host_crossover():
+        # Same crossover as ed25519: a kernel flush below it loses to the C
+        # host verifier (ops/chost does its own ristretto decodes + s<L).
+        from tendermint_tpu.ops import chost
+
+        if chost.available():
+            k32 = challenges([it[1] for it in items], pubs_arr, r32)
+            bitmap = chost.sr25519_verify(
+                pubs_arr, k32, s32, r32, sig_ok & marker_ok & pub_size_ok)
+            return None, lambda _unused: bitmap
+
+    ks, key_idx, pub_ok = get_keyset([it[0] for it in items])
+    pub_ok = pub_ok & ks.valid[key_idx]
     s_ok = sc.lt_l(s32)
     # R must be a canonical ristretto encoding: s < p and s even (the square
     # test runs on device inside the decode).
     r_ok = _lt_p(r32) & ((r32[:, 0] & 1) == 0)
     valid = sig_ok & marker_ok & s_ok & r_ok & pub_ok
 
-    pubs32, _ = edb._normalize_pubs([it[0] for it in items])
-    pubs_arr = np.frombuffer(pubs32, dtype=np.uint8).reshape(n, 32)
     k32 = challenges([it[1] for it in items], pubs_arr, r32)
 
     k_win = sc.comb_windows(k32).astype(np.int32)

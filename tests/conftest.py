@@ -49,6 +49,23 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "slow: subprocess/e2e tier")
 
 
+# Modules whose point is exercising the DEVICE kernels: pin the host/kernel
+# crossover to 0 there so the C host verifier (ops/chost) cannot absorb the
+# batches they mean to run through the kernel. Everything else keeps the
+# production adaptive routing.
+_KERNEL_PATH_MODULES = {
+    "test_ed25519_batch", "test_sr25519_batch", "test_multichip",
+    "test_pallas_tpu", "test_sha512_device", "test_perf_gate",
+}
+
+
+@pytest.fixture(autouse=True)
+def _pin_kernel_path(request, monkeypatch):
+    mod = request.module.__name__.rsplit(".", 1)[-1]
+    if mod in _KERNEL_PATH_MODULES:
+        monkeypatch.setenv("TM_TPU_HOST_CROSSOVER", "0")
+
+
 def pytest_collection_modifyitems(config, items):
     for item in items:
         mod = item.module.__name__.rsplit(".", 1)[-1]
