@@ -55,8 +55,14 @@ class BlockID:
         self.part_set_header.validate_basic()
 
     def key(self) -> bytes:
-        """Map key (reference: types/block.go BlockID.Key)."""
-        return self.hash + self.part_set_header.marshal()
+        """Map key (reference: types/block.go BlockID.Key). Cached: the
+        consensus hot path calls key() several times per vote, and both
+        fields are immutable (frozen dataclass, bytes)."""
+        k = self.__dict__.get("_key")
+        if k is None:
+            k = self.hash + self.part_set_header.marshal()
+            object.__setattr__(self, "_key", k)
+        return k
 
     def marshal(self) -> bytes:
         return (

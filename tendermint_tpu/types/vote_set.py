@@ -109,6 +109,9 @@ class VoteSet:
         results: list[tuple[bool, Exception | None]] = [None] * len(votes)  # type: ignore
         verifier = crypto_batch.create_batch_verifier()
         queued: list[int] = []
+        # Gossiped votes at one (height, round, step, block) share identical
+        # sign bytes; build each distinct canonical encoding once.
+        sb_memo: dict[tuple, bytes] = {}
         for i, vote in enumerate(votes):
             try:
                 checked = self._precheck(vote)
@@ -121,7 +124,12 @@ class VoteSet:
                 prechecked.append(None)
                 continue
             prechecked.append((vote, checked))
-            verifier.add(checked.pub_key, vote.sign_bytes(self.chain_id), vote.signature)
+            sb_key = (vote.height, vote.round, vote.type,
+                      vote.block_id.key(), vote.timestamp)
+            sb = sb_memo.get(sb_key)
+            if sb is None:
+                sb = sb_memo[sb_key] = vote.sign_bytes(self.chain_id)
+            verifier.add(checked.pub_key, sb, vote.signature)
             queued.append(i)
         if queued:
             _, bitmap = verifier.verify()
@@ -135,10 +143,19 @@ class VoteSet:
                     ))
                     continue
                 try:
-                    # Re-run the duplicate check: an earlier vote in this same
-                    # batch may have made this one a duplicate/conflict.
-                    if self._precheck(vote) is None:
-                        results[i] = (False, None)
+                    # Re-run ONLY the duplicate/conflict check (the rest of
+                    # _precheck is state-independent and already passed): an
+                    # earlier vote in this same batch may have made this one
+                    # a duplicate or a non-deterministic-signature error.
+                    existing = self._get_vote(vote.validator_index,
+                                              vote.block_id.key())
+                    if existing is not None:
+                        if existing.signature == vote.signature:
+                            results[i] = (False, None)
+                        else:
+                            results[i] = (False, VoteError(
+                                f"existing vote: {existing}; new vote: {vote}: "
+                                "non-deterministic signature"))
                         continue
                     added, conflicting = self._apply_verified(vote, val)
                     if conflicting is not None:
