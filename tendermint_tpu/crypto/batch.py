@@ -167,12 +167,13 @@ class MixedBatchVerifier(BatchVerifier):
         self._order.append((kt, len(sub)))
         sub.add(pub_key, msg, sig)
 
-    def verify(self) -> tuple[bool, list[bool]]:
-        # Dispatch every key type's kernel first, then fetch ALL results in
-        # one device_get: the tunnel readback is latency-bound, so a mixed
-        # ed25519+sr25519 commit pays one fetch floor instead of two.
-        import jax
-
+    def dispatch(self):
+        """Issue every key type's dispatch without fetching. Returns
+        (devs, resolve) where devs is a list of device arrays (None entries
+        for host-resolved sub-batches) and resolve(jax.device_get(devs)) ->
+        (all_ok, bitmap). Lets callers batch readbacks of SEVERAL flushes
+        (range sync chunks) into one device_get — the tunnel round trip is
+        latency-bound, so each extra fetch costs a full floor."""
         pairs = []
         for kt, sub in self._subs.items():
             if hasattr(sub, "dispatch"):
@@ -180,16 +181,28 @@ class MixedBatchVerifier(BatchVerifier):
             else:
                 res = sub.verify()
                 pairs.append((kt, None, lambda _fetched, _res=res: _res))
-        devs = [d for (_, d, _) in pairs if d is not None]
-        fetched = iter(jax.device_get(devs) if devs else [])
-        results = {}
-        for kt, d, resolve in pairs:
-            results[kt] = (resolve(next(fetched)) if d is not None
-                           else resolve(None))[1]
-        out = [results[kt][i] for (kt, i) in self._order]
+        order = self._order
         self._order = []
         self._subs = {}
-        return all(out), out
+        devs = [d for (_, d, _) in pairs]
+
+        def resolve(fetched):
+            results = {}
+            for (kt, _d, res), f in zip(pairs, fetched):
+                results[kt] = res(f)[1]
+            out = [results[kt][i] for (kt, i) in order]
+            return all(out), out
+
+        return devs, resolve
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        # Dispatch every key type's kernel first, then fetch ALL results in
+        # one device_get: the tunnel readback is latency-bound, so a mixed
+        # ed25519+sr25519 commit pays one fetch floor instead of two.
+        import jax
+
+        devs, resolve = self.dispatch()
+        return resolve(jax.device_get(devs))
 
     def __len__(self) -> int:
         return len(self._order)
@@ -207,7 +220,12 @@ def warmup(sizes: tuple[int, ...] = (64,), background: bool = True):
     bucket size is a cache hit, not a compile. No-op when batching is disabled
     or already warmed. Returns the warmup thread when background, else None."""
     global _WARMED
-    if _WARMED or os.environ.get("TM_TPU_DISABLE_BATCH") == "1":
+    if (_WARMED or os.environ.get("TM_TPU_DISABLE_BATCH") == "1"
+            or os.environ.get("TM_TPU_SKIP_WARMUP") == "1"):
+        # TM_TPU_SKIP_WARMUP: short-lived processes (tests) exit while a
+        # background XLA compile is mid-flight, which aborts the C++ runtime
+        # at teardown ("FATAL: exception not rethrown"); they also gain
+        # nothing from pre-compiling kernels they may never launch.
         return None
     _WARMED = True
 

@@ -56,9 +56,48 @@ def verify_header_range(trusted: LightBlock, chain: list[LightBlock],
     precompute_header_hashes(
         [lb.signed_header.header for lb in chain
          if lb.signed_header and lb.signed_header.header])
-    # Phase 1: host-side structural checks + signature collection.
+    # Phase 1 (DISPATCH): collect signature items and dispatch them in
+    # chunks as early as possible -- the tunnel's ~90 ms round trip is pure
+    # latency, so results dispatched now travel home (copy_to_host_async in
+    # ops dispatch) while phase 2 validates structure on host.  Chunks sit
+    # just above the host/kernel crossover so they take the ASYNC device
+    # path; the sub-crossover tail runs on host CPU under the same flights.
+    from tendermint_tpu.ops import ed25519_batch as _edb
+
+    chunk_sigs_target = _edb.host_crossover() + 256
     verifier = crypto_batch.create_batch_verifier()
     plan = []  # (lb, prefix, needed)
+    pending = []  # (plan_chunk, devs, resolve)
+    for lb in chain:
+        sh, vals = lb.signed_header, lb.validator_set
+        commit = sh.commit
+        if vals.size() != len(commit.signatures):
+            # full structural pass runs in phase 2; this one gates the
+            # prefix computation itself
+            raise RangeVerifyError(
+                sh.height, f"wrong set size: {vals.size()} vs {len(commit.signatures)}")
+        needed = vals.total_voting_power() * 2 // 3
+        prefix = vals.commit_light_prefix(commit, needed)
+        chain_id = sh.header.chain_id
+        validators = vals.validators
+        signatures = commit.signatures
+        add = verifier.add
+        for idx in prefix:
+            add(validators[idx].pub_key, commit.vote_sign_bytes(chain_id, idx),
+                signatures[idx].signature)
+        plan.append((lb, prefix, needed))
+        if len(verifier) >= chunk_sigs_target:
+            pending.append((plan,) + verifier.dispatch())
+            verifier = crypto_batch.create_batch_verifier()
+            plan = []
+    if plan:
+        pending.append((plan,) + verifier.dispatch())
+
+    # Phase 2 (STRUCTURE, overlapping the signature flights): the serial
+    # chain-linkage walk.  Same accept/reject set as the sequential loop;
+    # the module docstring's error-ordering caveat (structural defects
+    # reported before an earlier height's bad signature) already covers
+    # this ordering.
     prev = trusted
     for lb in chain:
         sh, vals = lb.signed_header, lb.validator_set
@@ -81,45 +120,34 @@ def verify_header_range(trusted: LightBlock, chain: list[LightBlock],
                 f"({prev.signed_header.header.next_validators_hash.hex()}) to match "
                 f"those from new header ({sh.header.validators_hash.hex()})"
             )
-        # commit.height == sh.height and commit.block_id == header hash were
-        # already enforced by sh.validate_basic inside
-        # _verify_new_header_and_vals; only the set-size check remains.
-        commit = sh.commit
-        if vals.size() != len(commit.signatures):
-            raise RangeVerifyError(
-                sh.height, f"wrong set size: {vals.size()} vs {len(commit.signatures)}")
-        needed = vals.total_voting_power() * 2 // 3
-        prefix = vals.commit_light_prefix(commit, needed)
-        chain_id = sh.header.chain_id
-        for idx in prefix:
-            verifier.add(
-                vals.validators[idx].pub_key,
-                commit.vote_sign_bytes(chain_id, idx),
-                commit.signatures[idx].signature,
-            )
-        plan.append((lb, prefix, needed))
         prev = lb
 
-    # Phase 2: ONE flush for the whole range.
-    _, bitmap = verifier.verify()
+    # Phase 3: ONE readback for every chunk's flush (device_get on the
+    # nested dev list; most results have already landed).
+    import jax
 
-    # Phase 3: replay each header's serial decision over its bitmap slice.
-    pos = 0
-    for lb, prefix, needed in plan:
-        vals, commit = lb.validator_set, lb.signed_header.commit
-        tallied = 0
-        ok_height = False
-        for idx, ok in zip(prefix, bitmap[pos:pos + len(prefix)]):
-            if not ok:
+    fetched = jax.device_get([devs for (_, devs, _) in pending])
+
+    # Phase 4: replay each header's serial decision over its bitmap slice.
+    for (plan_chunk, _devs, resolve), f in zip(pending, fetched):
+        _, bitmap = resolve(f)
+        pos = 0
+        for lb, prefix, needed in plan_chunk:
+            vals, commit = lb.validator_set, lb.signed_header.commit
+            tallied = 0
+            ok_height = False
+            for idx, ok in zip(prefix, bitmap[pos:pos + len(prefix)]):
+                if not ok:
+                    raise RangeVerifyError(
+                        lb.height,
+                        ErrWrongSignature(idx, commit.signatures[idx].signature))
+                tallied += vals.validators[idx].voting_power
+                if tallied > needed:
+                    ok_height = True
+                    break
+            pos += len(prefix)
+            if not ok_height:
                 raise RangeVerifyError(
-                    lb.height, ErrWrongSignature(idx, commit.signatures[idx].signature))
-            tallied += vals.validators[idx].voting_power
-            if tallied > needed:
-                ok_height = True
-                break
-        pos += len(prefix)
-        if not ok_height:
-            raise RangeVerifyError(
-                lb.height, ErrNotEnoughVotingPowerSigned(tallied, needed))
-        if store is not None:
-            store.save_light_block(lb)
+                    lb.height, ErrNotEnoughVotingPowerSigned(tallied, needed))
+            if store is not None:
+                store.save_light_block(lb)

@@ -46,16 +46,20 @@ def _lib_path() -> str:
 
 def _build(lib_path: str) -> bool:
     tmp = lib_path + f".tmp{os.getpid()}"
-    for flags in (["-march=native"], []):
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-x", "c",
-               _SRC, "-o", tmp] + flags
+    # gcc, not g++: the source is pure C, and linking libstdc++ into the .so
+    # made ITS terminate handler fire during interpreter teardown when node
+    # threads were mid-call ("FATAL: exception not rethrown" at exit).
+    for cc, flags in (("gcc", ["-march=native"]), ("gcc", []),
+                      ("g++", ["-x", "c"])):
+        cmd = ([cc, "-O3", "-shared", "-fPIC", "-pthread"] + flags
+               + [_SRC, "-o", tmp])
         try:
             r = subprocess.run(cmd, capture_output=True, timeout=180)
             if r.returncode == 0:
                 os.replace(tmp, lib_path)  # atomic vs concurrent builders
                 return True
         except (OSError, subprocess.TimeoutExpired):
-            return False
+            continue
     return False
 
 

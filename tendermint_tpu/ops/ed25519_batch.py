@@ -617,6 +617,7 @@ def dispatch_batch(items: list[tuple[bytes, bytes, bytes]],
         from tendermint_tpu.parallel import batch_shard
 
         dev = batch_shard.dispatch_batch_sharded(ks, key_idx, items, pub_ok)
+        _start_host_copy(dev)
         return dev, lambda v: np.asarray(v)[:n].astype(bool)
     if _use_pallas():
         # Prep is done chunk-by-chunk inside the pipelined path so device
@@ -624,6 +625,7 @@ def dispatch_batch(items: list[tuple[bytes, bytes, bytes]],
         from tendermint_tpu.ops import ed25519_pallas
 
         dev = ed25519_pallas.dispatch_items_pipelined(ks, key_idx, items, pub_ok)
+        _start_host_copy(dev)
         return dev, lambda v: np.asarray(v)[0, :n].astype(bool)
     s = prepare_scalars(items, pub_ok, windows=True)
 
@@ -640,7 +642,20 @@ def dispatch_batch(items: list[tuple[bytes, bytes, bytes]],
             k: jnp.asarray(v[off : off + JNP_TILE]) for k, v in padded.items()
         }))
     ok = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+    _start_host_copy(ok)
     return ok, lambda v: np.asarray(v)[:n].astype(bool)
+
+
+def _start_host_copy(dev) -> None:
+    """Begin the D2H transfer NOW: over this host's tunnel a device_get
+    issued after the command stream drains pays a fresh ~90 ms round trip
+    even when the result has long been computed; a copy started at dispatch
+    rides the active stream and makes the later fetch ~free (measured:
+    fetch 0.2 ms vs 88 ms after 150 ms of host work)."""
+    try:
+        dev.copy_to_host_async()
+    except (AttributeError, RuntimeError):
+        pass
 
 
 def verify_batch(items: list[tuple[bytes, bytes, bytes]],

@@ -312,6 +312,7 @@ def dispatch_batch(items: list[tuple[bytes, bytes, bytes]]):
             jnp.asarray(va[off:off + tile]),
         ))
     ok = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+    edb._start_host_copy(ok)
     return ok, lambda v: np.asarray(v)[:n]
 
 

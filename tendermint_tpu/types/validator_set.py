@@ -121,6 +121,8 @@ class ValidatorSet:
         new.validators = [v.copy() for v in self.validators]
         new.proposer = self.proposer
         new._total_voting_power = self._total_voting_power
+        # the set hash covers (pubkey, power) only, both copied verbatim
+        new._hash_cache = getattr(self, "_hash_cache", None)
         return new
 
     def validate_basic(self) -> None:
@@ -137,8 +139,17 @@ class ValidatorSet:
 
     def hash(self) -> bytes:
         """Merkle root over SimpleValidator marshals (reference:
-        types/validator_set.go:346-353)."""
-        return merkle.hash_from_byte_slices([v.bytes() for v in self.validators])
+        types/validator_set.go:346-353). Memoized: light-client range sync
+        hashes the same set once per header otherwise. The cache survives
+        copy() and is invalidated by update_with_change_set; proposer-
+        priority rotation does not enter the hash. Direct mutation of a
+        validator's power/key bypasses invalidation (same caller convention
+        as Header hash caching)."""
+        h = getattr(self, "_hash_cache", None)
+        if h is None:
+            h = merkle.hash_from_byte_slices([v.bytes() for v in self.validators])
+            self._hash_cache = h
+        return h
 
     # --- proposer rotation (reference: types/validator_set.go:107-245) -----
 
@@ -216,6 +227,7 @@ class ValidatorSet:
     def _update_with_change_set(self, changes: list[Validator], allow_deletes: bool) -> None:
         if not changes:
             return
+        self._hash_cache = None  # membership/power may change
         changes_sorted = sorted(changes, key=lambda v: v.address)
         for a, b in zip(changes_sorted, changes_sorted[1:]):
             if a.address == b.address:

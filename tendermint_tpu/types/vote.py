@@ -15,7 +15,7 @@ from dataclasses import dataclass, field, replace
 
 from tendermint_tpu.crypto import keys
 from tendermint_tpu.encoding import proto
-from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
 from tendermint_tpu.types.ttime import Time
 
 # SignedMsgType (proto/tendermint/types/types.proto:24-37)
@@ -54,27 +54,79 @@ def canonical_vote_bytes(chain_id: str, vtype: int, height: int, round_: int,
     """Delimited CanonicalVote marshal = the exact signed payload
     (reference: types/vote.go:93 VoteSignBytes).
 
-    In a vote drain every field except the timestamp repeats per
-    (chain_id, type, height, round, block_id), so the constant prefix and
-    suffix are templated (bounded cache) and the timestamp spliced in —
-    differential-tested against the plain construction."""
-    key = (chain_id, vtype, height, round_,
-           block_id.hash, block_id.part_set_header.total,
-           block_id.part_set_header.hash)
-    tmpl = _CV_TEMPLATES.get(key)
-    if tmpl is None:
-        if len(_CV_TEMPLATES) >= 64:  # a handful of (height, round) shapes live at once
+    Fast path: for the ubiquitous shape (32-byte hashes, small part total,
+    non-nil block) the byte layout is fixed given (chain_id, vtype, round,
+    total) — height is sfixed64 — so a splice template fills in height,
+    hashes and timestamp with one join instead of a Writer build per call.
+    The template is SELF-CHECKED against the Writer construction when
+    built: layout drift disables the fast path for that key rather than
+    ever signing wrong bytes. Light-client range sync builds one of these
+    per header; a cache keyed on (height, block_id) missed every time
+    there."""
+    psh = block_id.part_set_header
+    if not (len(block_id.hash) == 32 and len(psh.hash) == 32
+            and 0 < psh.total < 128 and 0 < height < 2**63
+            and 0 <= round_ < 2**63 and vtype != 0):
+        # height 0 is never signed; zero-valued proto fields are omitted by
+        # the Writer, so the fixed-layout assumption needs height > 0
+        return _canonical_vote_bytes_writer(
+            chain_id, vtype, height, round_, block_id, timestamp)
+    key = (chain_id, vtype, round_, psh.total)
+    tmpl = _CV_TEMPLATES.get(key, False)
+    if tmpl is False:
+        if len(_CV_TEMPLATES) >= 64:
             _CV_TEMPLATES.clear()
-        w = proto.Writer()
-        w.varint(1, vtype)
-        w.sfixed64(2, height)
-        w.sfixed64(3, round_)
-        cbid = canonical_block_id_bytes(block_id)
-        if cbid is not None:
-            w.message(4, cbid, always=True)
-        tmpl = (w.out(), proto.Writer().string(6, chain_id).out())
+        # layout: head|height8|mid1|bid.hash|mid2|psh.hash|ts|suffix
+        psh_inner = 1 + len(proto.encode_uvarint(psh.total)) + 2 + 32
+        f4_inner = 2 + 32 + 1 + len(proto.encode_uvarint(psh_inner)) + psh_inner
+        head = proto.Writer().varint(1, vtype).out() + b"\x11"
+        # round 0 (the common prevote/precommit round) is omitted entirely,
+        # like every zero-valued proto field the Writer drops
+        round_seg = (b"" if round_ == 0
+                     else b"\x19" + round_.to_bytes(8, "little"))
+        mid1 = (round_seg
+                + b"\x22" + proto.encode_uvarint(f4_inner) + b"\x0a\x20")
+        mid2 = (b"\x12" + proto.encode_uvarint(psh_inner)
+                + b"\x08" + proto.encode_uvarint(psh.total) + b"\x12\x20")
+        suf = proto.Writer().string(6, chain_id).out()
+        tmpl = (head, mid1, mid2, suf)
+        # self-check: any drift between this splice layout and the Writer
+        # path falls back to the Writer permanently for this key
+        chk_bid = BlockID(hash=b"\xa7" * 32,
+                          part_set_header=PartSetHeader(psh.total, b"\x5c" * 32))
+        chk_ts = Time(123456789, 987)
+        tsm = chk_ts.marshal()
+        fast = proto.delimited(
+            head + (54321).to_bytes(8, "little") + mid1 + chk_bid.hash
+            + mid2 + chk_bid.part_set_header.hash
+            + b"\x2a" + proto.encode_uvarint(len(tsm)) + tsm + suf)
+        if fast != _canonical_vote_bytes_writer(
+                chain_id, vtype, 54321, round_, chk_bid, chk_ts):
+            tmpl = None
         _CV_TEMPLATES[key] = tmpl
-    pre, suf = tmpl
+    if tmpl is None:
+        return _canonical_vote_bytes_writer(
+            chain_id, vtype, height, round_, block_id, timestamp)
+    head, mid1, mid2, suf = tmpl
+    tsm = timestamp.marshal()
+    return proto.delimited(
+        head + height.to_bytes(8, "little") + mid1 + block_id.hash
+        + mid2 + psh.hash + b"\x2a" + proto.encode_uvarint(len(tsm)) + tsm + suf)
+
+
+def _canonical_vote_bytes_writer(chain_id: str, vtype: int, height: int,
+                                 round_: int, block_id: BlockID,
+                                 timestamp: Time) -> bytes:
+    """Plain Writer-based construction (the layout source of truth)."""
+    w = proto.Writer()
+    w.varint(1, vtype)
+    w.sfixed64(2, height)
+    w.sfixed64(3, round_)
+    cbid = canonical_block_id_bytes(block_id)
+    if cbid is not None:
+        w.message(4, cbid, always=True)
+    pre = w.out()
+    suf = proto.Writer().string(6, chain_id).out()
     tsm = timestamp.marshal()
     # field 5 (timestamp), wire type 2: tag 0x2a; always emitted.
     return proto.delimited(pre + b"\x2a" + proto.encode_uvarint(len(tsm))

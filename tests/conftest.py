@@ -16,6 +16,9 @@ _KEEP_TPU = os.environ.get("TM_TPU_TEST_BACKEND") == "tpu"
 # process; the env vars exist so child processes tests spawn (e2e runner,
 # node subprocesses) inherit the same CPU-mesh setup.
 if not _KEEP_TPU:
+    # Short-lived test processes must not race a background XLA warmup
+    # compile at interpreter exit (C++ teardown abort); see crypto/batch.py.
+    os.environ.setdefault("TM_TPU_SKIP_WARMUP", "1")
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 if not _KEEP_TPU and (
         "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")):
