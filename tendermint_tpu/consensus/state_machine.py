@@ -185,6 +185,12 @@ class ConsensusState:
         self._timeout_queue: queue.Queue = queue.Queue()
         self._mtx = threading.RLock()
         self._holdover: object | None = None  # non-vote msg dequeued mid-drain
+        # In-flight batched vote flush: (msgs, queued, devs, resolve).  The
+        # drain dispatches a batch and keeps consuming the queue while the
+        # device verifies; the result is applied before ANY other state
+        # transition (next batch, timeout, non-vote message) so side-effect
+        # order stays exactly arrival order (VERDICT r4 item 1b).
+        self._pending_flush: tuple | None = None
         self._thread: threading.Thread | None = None
         self._running = False
         self.replay_mode = False
@@ -296,6 +302,12 @@ class ConsensusState:
             if mi is None:
                 try:
                     ti = self._timeout_queue.get_nowait()
+                except queue.Empty:
+                    ti = None
+                if ti is not None:
+                    # timeout decisions read round state: apply any
+                    # in-flight vote flush first
+                    self._flush_pending_votes()
                     # WAL the timeout HERE, at dequeue time, so WAL order
                     # matches processing order (reference consensus/state.go
                     # writes it in receiveRoutine immediately before
@@ -305,25 +317,32 @@ class ConsensusState:
                         self.wal.write(timeout_wal_blob(ti), _time.time_ns())
                     self._do_handle_timeout(ti)
                     continue
-                except queue.Empty:
-                    pass
                 if self._holdover is not None:
                     mi, self._holdover = self._holdover, None
                 else:
                     try:
-                        mi = self._msg_queue.get(timeout=0.02)
+                        mi = self._msg_queue.get_nowait()
                     except queue.Empty:
-                        continue
+                        # idle: nothing left to overlap the in-flight flush
+                        # with, resolve it now
+                        self._flush_pending_votes()
+                        try:
+                            mi = self._msg_queue.get(timeout=0.02)
+                        except queue.Empty:
+                            continue
             if mi is None:
+                self._flush_pending_votes()
                 return  # stop sentinel
             if isinstance(mi, tuple):
                 kind, payload = mi
                 if kind == "__sync__":
+                    self._flush_pending_votes()
                     if not self._internal_queue.empty() or not self._timeout_queue.empty():
                         self._msg_queue.put(mi)  # drain internals first
                     else:
                         payload.set()
                 elif kind == "__txs_available__":
+                    self._flush_pending_votes()
                     with self._mtx:
                         self._handle_txs_available()
                 continue
@@ -343,6 +362,9 @@ class ConsensusState:
                     with self._mtx:
                         self._handle_vote_batch(votes)
                     continue
+            # Any other message mutates state through _handle_msg: apply the
+            # in-flight vote flush first so side effects stay arrival-order.
+            self._flush_pending_votes()
             # WAL discipline (reference: state.go:753-780): internal messages
             # are fsync'd, peer messages buffered.
             if self.wal is not None and not self.replay_mode:
@@ -379,16 +401,26 @@ class ConsensusState:
         detection, maj23 bookkeeping, round transitions) are bit-identical to
         serial processing: the batch verifies exactly the triple
         (val_set[index].pub_key, sign_bytes(chain_id), signature) that
-        VoteSet.add_vote would check (reference: types/vote_set.go:205)."""
+        VoteSet.add_vote would check (reference: types/vote_set.go:205).
+
+        Device flushes are applied ASYNCHRONOUSLY: the dispatch is issued
+        here, the drain keeps consuming the queue while the device + tunnel
+        work, and the result is applied by _flush_pending_votes before any
+        later state transition (r4 verdict item 1b: overlap the sync floor
+        with consensus work). Verification inputs are state-independent --
+        (pubkey, sign bytes, signature) fixed at dispatch -- and batch k is
+        always applied before batch k+1, so observable ordering is exactly
+        the serial drain's."""
         from tendermint_tpu.crypto import batch as crypto_batch
 
         rs = self.rs
         val_set = rs.votes.val_set if rs.votes is not None else None
         height = rs.height
-        ok_by_i: dict[int, bool] = {}
         try:
             verifier = crypto_batch.create_batch_verifier()
             queued: list[int] = []
+            sb_memo: dict[tuple, bytes] = {}
+            chain_id = self.state.chain_id
             for i, m in enumerate(msgs):
                 v = m.msg.vote
                 if val_set is None or v.height != height:
@@ -398,12 +430,32 @@ class ConsensusState:
                 addr, val = val_set.get_by_index(v.validator_index)
                 if val is None or addr != v.validator_address:
                     continue
-                verifier.add(val.pub_key, v.sign_bytes(self.state.chain_id),
-                             v.signature)
+                sb_key = (v.height, v.round, v.type, v.block_id.key(),
+                          v.timestamp)
+                sb = sb_memo.get(sb_key)
+                if sb is None:
+                    sb = sb_memo[sb_key] = v.sign_bytes(chain_id)
+                verifier.add(val.pub_key, sb, v.signature)
                 queued.append(i)
-            if queued:
-                _, bitmap = verifier.verify()
-                ok_by_i = dict(zip(queued, bitmap))
+            if not queued:
+                # still apply batch k first: arrival order
+                self._flush_pending_votes(_locked=True)
+                self._apply_vote_results(msgs, {})
+                return
+            devs, resolve = verifier.dispatch()
+            has_device = any(
+                d is not None
+                for d in (devs if isinstance(devs, list) else [devs]))
+            # batch k+1 is now in flight; apply batch k (arrival order)
+            # while it travels
+            self._flush_pending_votes(_locked=True)
+            if has_device:
+                # stash; the drain loop applies it before the next state
+                # transition, overlapping the round trip with more draining
+                self._pending_flush = (msgs, queued, devs, resolve)
+                return
+            _, bitmap = resolve(devs if isinstance(devs, list) else None)
+            ok_by_i = dict(zip(queued, bitmap))
         except Exception as e:  # noqa: BLE001
             # A flush failure (device OOM, runtime hiccup) must not kill the
             # consensus thread; fall back to per-vote scalar verification.
@@ -411,6 +463,36 @@ class ConsensusState:
             if self.logger is not None:
                 self.logger.error("batched vote verify failed; falling back "
                                   "to serial", err=e)
+            self._flush_pending_votes(_locked=True)
+        self._apply_vote_results(msgs, ok_by_i)
+
+    def _flush_pending_votes(self, _locked: bool = False) -> None:
+        """Fetch and apply the in-flight batched vote flush, if any.
+        _locked=True when the caller already holds self._mtx."""
+        pf = self._pending_flush
+        if pf is None:
+            return
+        self._pending_flush = None
+        msgs, queued, devs, resolve = pf
+        ok_by_i: dict[int, bool] = {}
+        try:
+            import jax
+
+            _, bitmap = resolve(jax.device_get(devs))
+            ok_by_i = dict(zip(queued, bitmap))
+        except Exception as e:  # noqa: BLE001 - same fallback as the sync path
+            ok_by_i = {}
+            if self.logger is not None:
+                self.logger.error("batched vote verify failed; falling back "
+                                  "to serial", err=e)
+        if _locked:
+            self._apply_vote_results(msgs, ok_by_i)
+        else:
+            with self._mtx:
+                self._apply_vote_results(msgs, ok_by_i)
+
+    def _apply_vote_results(self, msgs: list[MsgInfo],
+                            ok_by_i: dict[int, bool]) -> None:
         for i, m in enumerate(msgs):
             ok = ok_by_i.get(i)
             if ok is False:
