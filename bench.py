@@ -283,6 +283,36 @@ def config_mixed_commit(rr):
                 gen_s=round(gen_s, 1), **detail)
 
 
+def config_sr25519(rr):
+    """VERDICT r4 item 3: a standalone sr25519 number. Pure sr25519
+    1000-validator commit through the production verify_commit path
+    (reference verifies these serially via go-schnorrkel,
+    crypto/sr25519/pubkey.go:10)."""
+    from tendermint_tpu.types.block import Header
+    from tendermint_tpu.types.block_id import BlockID
+    from tendermint_tpu.types.ttime import Time
+
+    t0 = time.monotonic()
+    privs, vals = _mk_valset(0, 1000)
+    header = Header(chain_id=BENCH_CHAIN, height=11, time=Time(1_700_000_110, 0),
+                    last_block_id=BlockID(), validators_hash=vals.hash(),
+                    next_validators_hash=vals.hash(),
+                    proposer_address=vals.validators[0].address)
+    commit = _sign_commit(header, vals, privs)
+    gen_s = time.monotonic() - t0
+
+    def run():
+        vals.verify_commit(BENCH_CHAIN, commit.block_id, 11, commit)
+
+    run()
+    value, detail = rr.run(run, iters=max(3, ITERS - 2))
+    base = BASELINE_US_PER_SIG * 1000 / 1000.0
+    return dict(metric="sr25519_1000v_commit_p50_ms", value=round(value, 1),
+                unit="ms", vs_baseline=round(base / value, 2),
+                us_per_sig=round(value, 1),
+                gen_s=round(gen_s, 1), **detail)
+
+
 def config_addvote(rr):
     """BASELINE config 5: the addVote hot loop — gossiped votes at a
     1024-validator height drained through VoteSet.add_votes (one batched
@@ -328,6 +358,13 @@ def main() -> None:
     _log(f"# backend={jax.default_backend()} devices={len(jax.devices())} "
          f"loadavg={os.getloadavg()}")
 
+    # Measure the host/kernel crossover BEFORE timing anything: the adaptive
+    # routing (VERDICT r4 item 1a) is part of what the bench measures.
+    cross = ed25519_batch.calibrate_host_crossover()
+    cal = ed25519_batch._HOST_CAL
+    _log(f"# crossover={cross} sigs (floor={cal['floor_ms']}ms host_rlc="
+         f"{None if cal['host_us'] is None else round(cal['host_us'], 1)}us/sig)")
+
     t0 = time.monotonic()
     items = _gen_flat_commit(N_SIGS)
     gen_s = time.monotonic() - t0
@@ -370,6 +407,7 @@ def main() -> None:
         ("commit150", config_commit150, (rr,)),
         ("range_verify", config_range_verify, (rr,)),
         ("mixed_commit", config_mixed_commit, (rr,)),
+        ("sr25519", config_sr25519, (rr,)),
         ("addvote", config_addvote, (rr,)),
     ):
         try:

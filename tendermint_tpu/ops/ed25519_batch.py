@@ -624,9 +624,10 @@ def dispatch_batch(items: list[tuple[bytes, bytes, bytes]],
         # compute overlaps host prep of the next chunk.
         from tendermint_tpu.ops import ed25519_pallas
 
-        dev = ed25519_pallas.dispatch_items_pipelined(ks, key_idx, items, pub_ok)
+        dev = ed25519_pallas.pack_bitmap(
+            ed25519_pallas.dispatch_items_pipelined(ks, key_idx, items, pub_ok))
         _start_host_copy(dev)
-        return dev, lambda v: np.asarray(v)[0, :n].astype(bool)
+        return dev, lambda v: ed25519_pallas.unpack_bitmap(np.asarray(v), n)
     s = prepare_scalars(items, pub_ok, windows=True)
 
     # Fixed-tile chunking: every batch runs through the one JNP_TILE-shaped

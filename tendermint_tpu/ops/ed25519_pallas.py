@@ -499,6 +499,22 @@ if CHUNK % TILE != 0 or CHUNK <= 0:
         f"TM_TPU_PALLAS_CHUNK must be a positive multiple of TILE={TILE}, got {CHUNK}")
 
 
+@jax.jit
+def pack_bitmap(ok):
+    """(1, N) int32 pass/fail lanes -> (N//32,) uint32 bitmask on device.
+    Shrinks the tunnel readback 32x (20,480 lanes: 80 KB -> 2.5 KB);
+    unpacked host-side by unpack_bitmap (r4 verdict item 2)."""
+    b = ok.reshape(-1, 32).astype(jnp.uint32)
+    w = jnp.left_shift(jnp.uint32(1), jnp.arange(32, dtype=jnp.uint32))
+    return (b * w).sum(axis=1, dtype=jnp.uint32)
+
+
+def unpack_bitmap(v: np.ndarray, n: int) -> np.ndarray:
+    """(N//32,) uint32 -> (n,) bool."""
+    bits = (v[:, None] >> np.arange(32, dtype=np.uint32)) & 1
+    return bits.reshape(-1)[:n].astype(bool)
+
+
 def dispatch_items_pipelined(ks, key_idx: np.ndarray, items, pub_ok):
     """Chunk-pipelined dispatch: host prep of chunk i+1 overlaps device
     compute of chunk i (dispatches are async). Returns the (1, Npad) int32
