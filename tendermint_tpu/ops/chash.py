@@ -45,7 +45,9 @@ _I32P = ctypes.POINTER(ctypes.c_int32)
 
 def _build(lib_path: str) -> bool:
     tmp = lib_path + ".tmp"
-    for flags in (["-fopenmp"], []):
+    # -march=native unlocks the 4-way AVX2 SHA-512 lanes in hash_batch.c
+    for flags in (["-fopenmp", "-march=native"], ["-march=native"],
+                  ["-fopenmp"], []):
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-x", "c", *_SRC_PATHS,
                "-o", tmp] + flags
         try:
