@@ -17,10 +17,17 @@ scheduler that explores EVERY reachable interleaving at a bounded scope
                contradictory votes, and every blamed validator is
                actually byzantine (spec/consensus.md "Accountability").
 
-The model covers Algorithm 1 of the Tendermint paper at the granularity
-the safety argument needs: proposals with POL rounds, prevote/precommit
-thresholds, lock/unlock via later-round polkas, nil votes and round
-skipping. Timeouts are modeled as always-enabled nil paths (asynchrony =
+The model covers the IMPLEMENTATION's voting rules (v0.34 semantics,
+which differ from the Tendermint paper's Algorithm 1 at the prevote
+step): a locked validator always prevotes its locked block
+(defaultDoPrevote, reference consensus/state.go:1256); locks move or
+release only at the precommit step on a polka (relock, lock-move, unlock
+on a nil polka or on a polka for an unfetched block, state.go:1320-1440).
+The r5 scope increase to three rounds earns its keep here: the
+prevote-lock discipline is exactly what makes unlock-on-nil-polka safe,
+and weakening it (lock_rule="amnesia") yields a fork the explorer finds
+only at max_round >= 2 — lock at round 0, amnesiac nil polka at round 1
+releases it, conflicting polka and commit at round 2. Timeouts are modeled as always-enabled nil paths (asynchrony =
 the scheduler may fire them whenever their guard holds). Byzantine
 validators "flood": every possible vote of theirs exists in the message
 soup from the start — the worst case, and it removes adversary choice
@@ -28,10 +35,9 @@ from the search. Asynchrony is the honest validators' nondeterministic
 choice of which enabled rule to fire next; the soup is monotone, so
 exploring all rule interleavings covers all delivery schedules.
 
-Code mapping: the modeled rules are the ones consensus/state_machine.py
-implements — _do_prevote's lock check, _enter_precommit's polka handling
-(lock set/move/unlock), _is_proposal_complete's pol_round evidence check,
-and VoteSet 2/3 thresholds (types/vote_set.py).
+Code mapping: consensus/state_machine.py _do_prevote (locked-block
+prevote), _enter_precommit (relock / lock-move / unlock paths), and
+VoteSet 2/3 thresholds (types/vote_set.py).
 """
 
 from __future__ import annotations
@@ -78,19 +84,53 @@ class Config:
     n_honest: int = 3
     n_byz: int = 1
     max_round: int = 1  # rounds 0..max_round inclusive
-    lock_rule: bool = True  # teeth: set False to break R4/R5
+    # lock discipline knob (teeth scenarios):
+    #   True      — the implementation's rules (defaultDoPrevote: locked ->
+    #               prevote the locked block, always)
+    #   False     — no lock at all (prevote anything, precommit any polka)
+    #   "amnesia" — locks are kept at the precommit step but a locked
+    #               validator may prevote nil on timeout, "forgetting" its
+    #               lock at the prevote step. Combined with the reference's
+    #               unlock-on-nil-polka this forks — and the fork needs
+    #               three rounds (lock@0, amnesiac nil polka@1 unlocks,
+    #               conflicting polka+commit@2), which is exactly why the
+    #               r5 scope increase to max_round=2 has teeth.
+    lock_rule: object = True
     quorum: int | None = None  # default = the reference's >2/3 rule
+    # per-validator voting powers (honest first, then byzantine); None =
+    # unit powers. Quorum defaults to >2/3 of TOTAL power either way
+    # (types/validator_set.py total_voting_power semantics).
+    powers: tuple | None = None
+    # Model decisions as explicit transitions (needed for trace-level blame
+    # analysis). For pure safety sweeps set False: the DECIDE action sends
+    # nothing and halts its validator, and its guard is monotone in the
+    # soup, so "two honest decide differently" is reachable IFF a state
+    # with two conflicting precommit quorums is — which explore() then
+    # checks as a state predicate instead. Cuts the explored space hard
+    # (every quorum otherwise spawns decide successors per validator).
+    decide_actions: bool = True
 
     def __post_init__(self):
         n = self.n_honest + self.n_byz
+        if self.powers is not None and len(self.powers) != n:
+            raise ValueError("powers must cover every validator")
         if self.quorum is None:
-            # strictly more than 2/3 of total power (types/vote_set.py
-            # two-thirds majority; equal unit powers here)
-            self.quorum = (2 * n) // 3 + 1
+            self.quorum = (2 * self.total_power) // 3 + 1
 
     @property
     def n(self) -> int:
         return self.n_honest + self.n_byz
+
+    @property
+    def total_power(self) -> int:
+        return sum(self.powers) if self.powers is not None else self.n
+
+    def power(self, voter: int) -> int:
+        return self.powers[voter] if self.powers is not None else 1
+
+    @property
+    def byz_power(self) -> int:
+        return sum(self.power(i) for i in range(self.n_honest, self.n))
 
 
 def byzantine_soup(cfg: Config) -> frozenset[Vote]:
@@ -118,14 +158,33 @@ def proposals(cfg: Config) -> tuple[Proposal, ...]:
     return tuple(out)
 
 
-def count(votes: frozenset[Vote], r: int, t: str, v: str | None) -> int:
-    """Voting power (1 each) for (round, type, value); value None = any,
-    counting DISTINCT voters (an equivocator contributes 1 to the any-vote
+def count(cfg: Config, votes: frozenset[Vote], r: int, t: str,
+          v: str | None) -> int:
+    """Voting power for (round, type, value); value None = any, counting
+    DISTINCT voters (an equivocator contributes once to the any-vote
     tally, exactly like types/vote_set.py sum-of-powers semantics)."""
     if v is None:
-        return len({x.voter for x in votes if x.round == r and x.type == t})
-    return sum(1 for x in votes
+        return sum(cfg.power(w) for w in
+                   {x.voter for x in votes if x.round == r and x.type == t})
+    return sum(cfg.power(x.voter) for x in votes
                if x.round == r and x.type == t and x.value == v)
+
+
+def tally_soup(cfg: Config, soup: frozenset) -> dict:
+    """One pass over the soup -> {(r, t, v): power, (r, t, None): distinct-
+    voter power}. explore() computes this once per state instead of letting
+    every rule instance rescan the soup."""
+    tl: dict = {}
+    anyv: dict = {}
+    for x in soup:
+        p = cfg.power(x.voter)
+        k = (x.round, x.type, x.value)
+        tl[k] = tl.get(k, 0) + p
+        d = anyv.setdefault((x.round, x.type), {})
+        d.setdefault(x.voter, p)
+    for (r, t), d in anyv.items():
+        tl[(r, t, None)] = sum(d.values())
+    return tl
 
 
 # ---------------------------------------------------------------------------
@@ -135,78 +194,104 @@ def count(votes: frozenset[Vote], r: int, t: str, v: str | None) -> int:
 
 
 def enabled_actions(cfg: Config, soup: frozenset[Vote],
-                    props: tuple[Proposal, ...], me: int, s: HonestState):
+                    props: tuple[Proposal, ...], me: int, s: HonestState,
+                    tl: dict | None = None):
     """Yield (label, new_state, sent_votes) for every rule instance honest
-    validator `me` may fire in the current message soup."""
+    validator `me` may fire in the current message soup. `tl` is an
+    optional precomputed tally_soup(cfg, soup)."""
     if s.decided != NIL:
         return
+    if tl is None:
+        tl = tally_soup(cfg, soup)
     q = cfg.quorum
     r = s.round
 
     if s.step == PROPOSE:
-        # upon PROPOSAL(r, v, -1): prevote v iff lock allows
-        # (state_machine.py _do_prevote; Algorithm 1 line 22).
-        for p in props:
-            if p.round != r or p.pol_round != -1:
-                continue
-            ok = (not cfg.lock_rule or s.locked_round == -1
-                  or s.locked_value == p.value)
-            vote = p.value if ok else NIL
-            yield (f"prevote{r}:{vote}",
+        locked = bool(cfg.lock_rule) and s.locked_round >= 0
+        if locked and cfg.lock_rule is True:
+            # v0.34 defaultDoPrevote (reference consensus/state.go:1256-1259,
+            # mirrored by state_machine.py _do_prevote): a locked validator
+            # ALWAYS prevotes its locked block — proposals and timeouts
+            # change nothing. THIS is what makes the implementation's
+            # unlock-on-nil-polka safe: while f+1 honest hold locks on the
+            # decided value, no nil polka (and no other polka) can form.
+            yield (f"prevote{r}:{s.locked_value}",
                    replace(s, step=PREVOTE_STEP),
-                   (Vote(r, "prevote", vote, me),))
-        # upon PROPOSAL(r, v, vr) + 2f+1 PREVOTE(vr, v), vr < r
-        # (Algorithm 1 line 28; _is_proposal_complete POL evidence).
-        for p in props:
-            if p.round != r or p.pol_round < 0:
-                continue
-            if count(soup, p.pol_round, "prevote", p.value) < q:
-                continue
-            ok = (not cfg.lock_rule or s.locked_round <= p.pol_round
-                  or s.locked_value == p.value)
-            vote = p.value if ok else NIL
-            yield (f"prevote{r}:{vote}(pol{p.pol_round})",
+                   (Vote(r, "prevote", s.locked_value, me),))
+        else:
+            if locked:  # cfg.lock_rule == "amnesia"
+                # prevote-amnesia bug: the validator still knows its lock
+                # (may prevote it) but on timeout "forgets" and prevotes
+                # nil like an unlocked one (the removed guard above). The
+                # explorer finds the resulting fork — it needs THREE
+                # rounds: lock at 0, nil polka at 1 (the amnesiac nil
+                # prevotes), unlock, conflicting polka+commit at 2.
+                yield (f"prevote{r}:{s.locked_value}",
+                       replace(s, step=PREVOTE_STEP),
+                       (Vote(r, "prevote", s.locked_value, me),))
+            else:
+                # unlocked: prevote any current-round proposal...
+                for v in sorted({p.value for p in props if p.round == r}):
+                    yield (f"prevote{r}:{v}",
+                           replace(s, step=PREVOTE_STEP),
+                           (Vote(r, "prevote", v, me),))
+            # ...or nil on timeout_propose / invalid proposal.
+            yield (f"prevote{r}:nil(timeout)",
                    replace(s, step=PREVOTE_STEP),
-                   (Vote(r, "prevote", vote, me),))
-        # timeout_propose: prevote nil (Algorithm 1 line 57).
-        yield (f"prevote{r}:nil(timeout)",
-               replace(s, step=PREVOTE_STEP),
-               (Vote(r, "prevote", NIL, me),))
+                   (Vote(r, "prevote", NIL, me),))
 
     elif s.step == PREVOTE_STEP:
-        # upon 2f+1 PREVOTE(r, v): lock + precommit v
-        # (Algorithm 1 line 36; _enter_precommit polka path).
+        # enterPrecommit (reference consensus/state.go:1320-1440, mirrored
+        # by _enter_precommit): on a polka for v — relock if already locked
+        # on v; else either move the lock and precommit v (validator has
+        # the block) or unlock and precommit nil (polka for a block it
+        # does not have; the polka itself is the POL for the unlock).
         for v in VALUES:
-            if count(soup, r, "prevote", v) < q:
+            if tl.get((r, "prevote", v), 0) < q:
                 continue
+            if not cfg.lock_rule:
+                yield (f"precommit{r}:{v}",
+                       replace(s, step=PRECOMMIT_STEP),
+                       (Vote(r, "precommit", v, me),))
+                continue
+            if s.locked_value == v:
+                yield (f"precommit{r}:{v}",
+                       replace(s, step=PRECOMMIT_STEP, locked_round=r),
+                       (Vote(r, "precommit", v, me),))
+            else:
+                yield (f"precommit{r}:{v}",
+                       replace(s, step=PRECOMMIT_STEP,
+                               locked_value=v, locked_round=r),
+                       (Vote(r, "precommit", v, me),))
+                yield (f"precommit{r}:nil(noblock)",
+                       replace(s, step=PRECOMMIT_STEP,
+                               locked_value=NIL, locked_round=-1),
+                       (Vote(r, "precommit", NIL, me),))
+        # +2/3 prevoted nil: unlock, precommit nil (state.go:1367-1383).
+        if tl.get((r, "prevote", NIL), 0) >= q:
             ns = replace(s, step=PRECOMMIT_STEP)
             if cfg.lock_rule:
-                ns = replace(ns, locked_value=v, locked_round=r)
-            yield (f"precommit{r}:{v}", ns, (Vote(r, "precommit", v, me),))
-        # upon 2f+1 PREVOTE(r, nil): precommit nil (line 44). A nil polka
-        # at a round above the lock releases it (_enter_precommit:782-785).
-        if count(soup, r, "prevote", NIL) >= q:
-            ns = replace(s, step=PRECOMMIT_STEP)
-            if cfg.lock_rule and s.locked_round < r:
                 ns = replace(ns, locked_value=NIL, locked_round=-1)
             yield (f"precommit{r}:nil", ns, (Vote(r, "precommit", NIL, me),))
-        # timeout_prevote after 2f+1 any prevotes: precommit nil (line 61).
-        if count(soup, r, "prevote", None) >= q:
+        # timeout_prevote after 2f+1 any prevotes: precommit nil, KEEPING
+        # the lock (no polka, no POL to unlock on).
+        if tl.get((r, "prevote", None), 0) >= q:
             yield (f"precommit{r}:nil(timeout)",
                    replace(s, step=PRECOMMIT_STEP),
                    (Vote(r, "precommit", NIL, me),))
 
     elif s.step == PRECOMMIT_STEP:
         # timeout_precommit after 2f+1 any precommits: next round (line 65).
-        if r < cfg.max_round and count(soup, r, "precommit", None) >= q:
+        if r < cfg.max_round and tl.get((r, "precommit", None), 0) >= q:
             yield (f"round{r + 1}", replace(s, round=r + 1, step=PROPOSE), ())
 
     # upon 2f+1 PRECOMMIT(r', v) at ANY time: decide v (line 49).
-    for rr in range(cfg.max_round + 1):
-        for v in VALUES:
-            if count(soup, rr, "precommit", v) >= q:
-                yield (f"decide:{v}@{rr}",
-                       replace(s, decided=v, step=DONE), ())
+    if cfg.decide_actions:
+        for rr in range(cfg.max_round + 1):
+            for v in VALUES:
+                if tl.get((rr, "precommit", v), 0) >= q:
+                    yield (f"decide:{v}@{rr}",
+                           replace(s, decided=v, step=DONE), ())
 
 
 # ---------------------------------------------------------------------------
@@ -223,48 +308,102 @@ class Result:
     decisions_seen: set = field(default_factory=set)
 
 
+def _state_key(s: HonestState) -> tuple:
+    return (s.round, s.step, s.locked_value, s.locked_round, s.decided)
+
+
+def _canon(honest: tuple, sent: frozenset, n_honest: int):
+    """Canonical representative of the honest-permutation orbit.
+
+    Equal-power honest validators are interchangeable: permuting their ids
+    (consistently in the state tuple AND the vote soup) is an automorphism
+    of the transition system. Sorting by (state, own sent votes) picks one
+    representative per orbit exactly — validators with identical keys are
+    genuinely indistinguishable, so any further permutation among them
+    leaves (honest, sent) invariant. Cuts the explored space up to
+    n_honest! without losing any reachable inequivalent configuration."""
+    per = [tuple(sorted((v.round, v.type, v.value)
+                        for v in sent if v.voter == i))
+           for i in range(n_honest)]
+    order = sorted(range(n_honest),
+                   key=lambda i: (_state_key(honest[i]), per[i]))
+    if order == list(range(n_honest)):
+        return honest, sent
+    relab = {old: new for new, old in enumerate(order)}
+    nh = tuple(honest[i] for i in order)
+    ns = frozenset(
+        Vote(v.round, v.type, v.value, relab.get(v.voter, v.voter))
+        for v in sent)
+    return nh, ns
+
+
 def explore(cfg: Config, max_states: int = 2_000_000,
-            stop_at_violation: bool = False) -> Result:
+            stop_at_violation: bool = False,
+            symmetry_reduce: bool = False) -> Result:
     """DFS every reachable configuration; record the first agreement
     violation (two honest validators decided differently) with its trace.
 
     When f < N/3 the one-polka-per-round lemma (spec/consensus.md Lemma 1)
     is also checked at every reached state. `stop_at_violation` aborts the
     search at the first agreement violation (for the teeth checks, where
-    one witness trace suffices)."""
+    one witness trace suffices). `symmetry_reduce` merges honest-validator
+    permutation orbits (equal powers only); traces then carry relabeled
+    validator ids, so blame analysis (fork_blame) should run with the
+    reduction OFF."""
+    if symmetry_reduce and cfg.powers is not None and len(
+            set(cfg.powers[:cfg.n_honest])) > 1:
+        raise ValueError("symmetry reduction requires equal honest powers")
     props = proposals(cfg)
     byz = byzantine_soup(cfg)
-    check_lemma1 = cfg.n_byz * 3 < cfg.n
+    check_lemma1 = cfg.byz_power * 3 < cfg.total_power
     init = (tuple(HonestState() for _ in range(cfg.n_honest)), frozenset())
     seen = set()
     res = Result()
     stack = [(init, ())]
     while stack:
         (honest, sent), trace = stack.pop()
+        if symmetry_reduce:
+            honest, sent = _canon(honest, sent, cfg.n_honest)
         if (honest, sent) in seen:
             continue
         seen.add((honest, sent))
         res.states += 1
         if res.states > max_states:
             raise RuntimeError(f"state budget exceeded ({max_states})")
-        decided = [s.decided for s in honest if s.decided != NIL]
-        res.decisions_seen.update(decided)
-        if len(set(decided)) > 1:
-            if res.violation is None:
-                res.violation = (trace, honest)
-            res.violations.append((trace, honest))
-            if stop_at_violation:
-                return res
-            continue  # no need to extend a violating trace
         soup = byz | sent
+        tl = tally_soup(cfg, soup)
+        if cfg.decide_actions:
+            decided = [s.decided for s in honest if s.decided != NIL]
+            res.decisions_seen.update(decided)
+            if len(set(decided)) > 1:
+                if res.violation is None:
+                    res.violation = (trace, honest)
+                res.violations.append((trace, honest))
+                if stop_at_violation:
+                    return res
+                continue  # no need to extend a violating trace
+        else:
+            # decide-free mode: "two honest decide differently" reachable
+            # IFF two conflicting precommit quorums coexist (see Config).
+            committed = {v for rr in range(cfg.max_round + 1)
+                         for v in VALUES
+                         if tl.get((rr, "precommit", v), 0) >= cfg.quorum}
+            res.decisions_seen.update(committed)
+            if len(committed) > 1:
+                if res.violation is None:
+                    res.violation = (trace, honest)
+                res.violations.append((trace, honest))
+                if stop_at_violation:
+                    return res
+                continue
         if check_lemma1 and res.lemma1_violation is None:
             for r in range(cfg.max_round + 1):
                 polkas = [v for v in VALUES
-                          if count(soup, r, "prevote", v) >= cfg.quorum]
+                          if tl.get((r, "prevote", v), 0) >= cfg.quorum]
                 if len(polkas) > 1:
                     res.lemma1_violation = (r, soup)
         for i, s in enumerate(honest):
-            for label, ns, out in enabled_actions(cfg, soup, props, i, s):
+            for label, ns, out in enabled_actions(cfg, soup, props, i, s, tl):
                 nh = tuple(ns if j == i else h for j, h in enumerate(honest))
                 nsent = sent | frozenset(out)
                 if (nh, nsent) not in seen:
@@ -308,3 +447,58 @@ def fork_blame(cfg: Config, trace, honest) -> set[int]:
         if len(concrete) > 1 or (concrete and NIL in vals):
             blamed.add(voter)
     return blamed
+
+
+# ---------------------------------------------------------------------------
+# Bounded liveness under synchrony.
+# ---------------------------------------------------------------------------
+
+
+def synchronous_run(cfg: Config, value: str = "A",
+                    withhold_round0: bool = False) -> tuple[int, frozenset]:
+    """Deterministic post-GST schedule: every honest validator sees the full
+    soup and fires the most progress-making enabled rule each step (prefer
+    value prevotes/precommits and decisions over nil/timeout paths).
+    Returns (rounds needed until ALL honest decided `value`, final soup);
+    raises if the round budget runs out — the bounded-liveness claim
+    (spec/consensus.md termination under synchrony with a correct
+    proposer).  withhold_round0 models a faulty round-0 proposer: honest
+    validators time out, skip the round, and round 1 must decide."""
+    props = tuple(p for p in proposals(cfg)
+                  if p.value == value or p.pol_round >= 0 or withhold_round0)
+    byz = byzantine_soup(cfg)
+    honest = [HonestState() for _ in range(cfg.n_honest)]
+    sent: set[Vote] = set()
+
+    def pick(me: int, s: HonestState):
+        best = None
+        usable = tuple(p for p in props
+                       if not (withhold_round0 and p.round == 0))
+        for act in enabled_actions(cfg, frozenset(sent) | byz, usable, me, s):
+            label = act[0]
+            rank = (2 if label.startswith("decide:" + value)
+                    else 1 if (":" + value) in label
+                    else 0)
+            if best is None or rank > best[0]:
+                best = (rank, act)
+        return None if best is None else best[1]
+
+    for _step in range(cfg.n_honest * (cfg.max_round + 1) * 8):
+        progressed = False
+        for i in range(cfg.n_honest):
+            if honest[i].decided != NIL:
+                continue
+            act = pick(i, honest[i])
+            if act is None:
+                continue
+            _label, ns, out = act
+            honest[i] = ns
+            sent.update(out)
+            progressed = True
+        if all(s.decided == value for s in honest):
+            return max(s.round for s in honest), frozenset(sent)
+        if not progressed:
+            break
+    raise AssertionError(
+        f"liveness: honest validators failed to decide {value} within the "
+        f"round budget; states={honest}")
