@@ -204,7 +204,12 @@ class Mempool:
             raise ErrMempoolIsFull(len(self._txs), self.max_txs,
                                    self._txs_bytes, self.max_txs_bytes)
         victims = [m for m in self._txs.values() if m.priority < priority]
-        if not victims or sum(len(v.tx) for v in victims) < need_bytes:
+        # Feasibility mirrors the reference exactly (mempool/v1/mempool.go
+        # canAddTx caller): reject unless the victims' TOTAL size covers the
+        # FULL size of the incoming tx — not merely the byte overflow
+        # (round-4 advisor finding: the overflow comparison admitted txs in
+        # near-full edge cases the reference rejects).
+        if not victims or sum(len(v.tx) for v in victims) < len(tx):
             self.cache.remove(tx)
             raise ErrMempoolIsFull(len(self._txs), self.max_txs,
                                    self._txs_bytes, self.max_txs_bytes)

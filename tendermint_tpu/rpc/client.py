@@ -289,10 +289,18 @@ class LocalClient(_ClientBase):
         with self._sub_mtx:
             self._sub_seq += 1
             subscriber = f"{self.SUBSCRIBER}-{self._sub_seq}"
+        from tendermint_tpu.types.events import SubscriptionCancelled
+
         sub = self._node.event_bus.subscribe(subscriber, query)
         try:
             while True:
-                msg = sub.next(timeout=timeout or 1.0)
+                try:
+                    msg = sub.next(timeout=timeout or 1.0)
+                except SubscriptionCancelled:
+                    # bus shutdown with an empty queue: clean end of
+                    # iteration, not an internal exception escaping the
+                    # generator (round-4 advisor finding)
+                    return
                 if msg is None:
                     if sub.cancelled:
                         return

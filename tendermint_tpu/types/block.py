@@ -81,6 +81,14 @@ class Header:
     _hash_cache: bytes | None = dc_field(
         default=None, repr=False, compare=False)
 
+    def __setattr__(self, name, value):
+        # Invalidate the cached root on ANY later field mutation: a stale
+        # hash() after mutation would silently corrupt block ids (round-4
+        # advisor finding; previously safe only by caller convention).
+        if name != "_hash_cache" and self.__dict__.get("_hash_cache") is not None:
+            self.__dict__["_hash_cache"] = None
+        object.__setattr__(self, name, value)
+
     def hash_fields(self) -> list[bytes]:
         """The 14 merkle leaves of the header hash
         (reference: types/block.go:440-476)."""
