@@ -221,15 +221,22 @@ def test_e2e_state_sync_bootstrap(tmp_path):
     server, genesis = _mk_server_node(tmp_path)
     server.start()
     try:
-        # Feed txs so snapshots have real content; wait past snapshot height 8.
-        deadline = time.monotonic() + 60
+        # Feed txs so snapshots have real content; wait past snapshot height 8
+        # (progress-based: stalls fail, slow-but-advancing chains don't).
+        from tendermint_tpu.e2e.runner import wait_progress
+
         fed = 0
-        while time.monotonic() < deadline and server.block_store.height < 10:
+
+        def feed():
+            nonlocal fed
             if fed < 30:
                 server.mempool.check_tx(b"ss%d=val%d" % (fed, fed))
                 fed += 1
-            time.sleep(0.05)
-        assert server.block_store.height >= 10
+
+        wait_progress(lambda: server.block_store.height,
+                      lambda h: h >= 10, idle_budget_s=30, hard_cap_s=300,
+                      what="server chain reaching height 10", tick=feed,
+                      poll_s=0.05)
 
         trust_meta = server.block_store.load_block_meta(2)
         cfg = test_config()
@@ -255,15 +262,11 @@ def test_e2e_state_sync_bootstrap(tmp_path):
         try:
             # State sync must land at a snapshot height (>= 4), then fast
             # sync takes it toward the tip.
-            deadline = time.monotonic() + 90
-            synced_state = None
-            while time.monotonic() < deadline:
-                st = fresh.state_store.load()
-                if st.last_block_height >= 4:
-                    synced_state = st
-                    break
-                time.sleep(0.2)
-            assert synced_state is not None, "state sync never completed"
+            wait_progress(lambda: fresh.state_store.load().last_block_height,
+                          lambda h: h >= 4, idle_budget_s=45, hard_cap_s=360,
+                          what="state sync reaching a snapshot height",
+                          poll_s=0.2)
+            synced_state = fresh.state_store.load()
             # The node bootstrapped at a snapshot height: block 1 was never
             # fetched, and the first stored block is snapshot_height+1
             # (fast sync may already be advancing state past the snapshot,
@@ -272,9 +275,10 @@ def test_e2e_state_sync_bootstrap(tmp_path):
 
             # Fast sync catches up past the snapshot height.
             target = synced_state.last_block_height + 2
-            while time.monotonic() < deadline and fresh.block_store.height < target:
-                time.sleep(0.2)
-            assert fresh.block_store.height >= target
+            wait_progress(lambda: fresh.block_store.height,
+                          lambda h: h >= target, idle_budget_s=45,
+                          hard_cap_s=360,
+                          what="fast sync passing the snapshot", poll_s=0.2)
             base = fresh.block_store.base
             assert base > 1 and base % 4 == 1, base  # snapshot_height + 1
             q = fresh.app.query(abci.RequestQuery(path="", data=b"ss3"))
