@@ -299,6 +299,17 @@ def cmd_light(args) -> int:
     return 0
 
 
+def cmd_signer_harness(args) -> int:
+    """Operator tool: validate a remote signer deployment (reference:
+    tools/tm-signer-harness, docs/tools/remote-signer-validation.md)."""
+    from tendermint_tpu.privval.harness import run_harness, summary_json
+
+    code = run_harness(args.addr, args.chain_id, home=args.home,
+                       accept_timeout_s=args.accept_timeout)
+    print(summary_json(code))
+    return code
+
+
 def cmd_replay(args) -> int:
     """Replay the block store through a fresh app and report the final state
     (reference: cmd/tendermint/commands/replay.go + consensus/replay_file.go).
@@ -525,6 +536,17 @@ def main(argv=None) -> int:
     sp.add_argument("--laddr", default="",
                     help="serve a verifying RPC proxy on this address")
     sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser(
+        "signer-harness",
+        help="validate a remote signer deployment (reference: "
+             "tools/tm-signer-harness)")
+    sp.add_argument("--addr", required=True,
+                    help="listen address the remote signer dials, e.g. "
+                         "tcp://127.0.0.1:26659")
+    sp.add_argument("--chain-id", required=True)
+    sp.add_argument("--accept-timeout", type=float, default=30.0)
+    sp.set_defaults(fn=cmd_signer_harness)
 
     sp = sub.add_parser("replay", help="replay the block store through the app")
     sp.set_defaults(fn=cmd_replay)

@@ -146,3 +146,59 @@ def test_consensus_with_remote_signer(tmp_path):
     finally:
         node.stop()
         server.stop()
+
+
+def test_signer_harness_validates_deployment(tmp_path):
+    """The operator harness (privval/harness.py; reference
+    tools/tm-signer-harness): a well-behaved FilePV-backed remote signer
+    passes every check with exit 0; a signer holding a DIFFERENT key than
+    priv_validator_key.json exits with the key-mismatch code."""
+    import json
+    import os
+    import shutil
+    import threading
+
+    from tendermint_tpu.privval import harness as hn
+
+    home = tmp_path / "home"
+    (home / "config").mkdir(parents=True)
+    pv = FilePV.generate(str(home / "config" / "priv_validator_key.json"),
+                         str(home / "config" / "priv_validator_state.json"),
+                         seed=b"\x91" * 32)
+
+    import socket
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    logs = []
+    laddr = f"tcp://127.0.0.1:{free_port()}"
+    server = SignerServer(pv, laddr)
+    server.start()
+    try:
+        code = hn.run_harness(laddr, CHAIN_ID, home=str(home),
+                              accept_timeout_s=20.0, log=logs.append)
+        assert code == hn.EXIT_OK, logs
+        doc = json.loads(hn.summary_json(code))
+        assert doc == {"exit_code": 0, "result": "ok"}
+    finally:
+        server.stop()
+
+    # a signer with the WRONG key: key-mismatch exit code
+    wrong = FilePV.generate(str(tmp_path / "other_key.json"),
+                            str(tmp_path / "other_state.json"),
+                            seed=b"\x92" * 32)
+    logs = []
+    laddr = f"tcp://127.0.0.1:{free_port()}"
+    server = SignerServer(wrong, laddr)
+    server.start()
+    try:
+        code = hn.run_harness(laddr, CHAIN_ID, home=str(home),
+                              accept_timeout_s=20.0, log=logs.append)
+        assert code == hn.EXIT_KEY_MISMATCH, logs
+    finally:
+        server.stop()
