@@ -52,12 +52,11 @@ def _log(msg: str) -> None:
 
 
 def _spin_ms() -> float:
-    """Fixed CPU workload -> elapsed ms; inflation == host contention."""
-    t0 = time.monotonic()
-    x = 0
-    for i in range(400_000):
-        x += i
-    return (time.monotonic() - t0) * 1e3
+    """Fixed CPU workload -> elapsed ms; inflation == host contention.
+    Shared with the e2e runner's load-scaled progress waits."""
+    from tendermint_tpu.e2e.runner import _spin_ms as probe
+
+    return probe()
 
 
 def _measure(fn, iters):

@@ -118,15 +118,24 @@ _COND_RE = re.compile(
 )
 
 
+# In-band tag for temporal condition operands. \x00 cannot appear in a
+# parsed value token and never legitimately starts a quoted operand, so a
+# user string like 'TIME up' can never be mistaken for a temporal operand.
+_TEMPORAL_TAG = "\x00"
+
+
 def _parse_operand_time(v: str):
     """RFC3339 (`TIME ...`) or 2006-01-02 (`DATE ...`) -> aware datetime,
     None when unparseable (the reference errors the match out; we treat it
-    as no-match)."""
+    as no-match). RFC3339 requires a UTC offset: zone-less values return
+    None rather than a naive datetime (which would make later comparisons
+    raise instead of not matching)."""
     import datetime as _dt
 
     try:
         if "T" in v:
-            return _dt.datetime.fromisoformat(v.replace("Z", "+00:00"))
+            t = _dt.datetime.fromisoformat(v.replace("Z", "+00:00"))
+            return t if t.tzinfo is not None else None
         d = _dt.date.fromisoformat(v)
         return _dt.datetime(d.year, d.month, d.day, tzinfo=_dt.timezone.utc)
     except ValueError:
@@ -185,18 +194,18 @@ class Query:
                 else:
                     val = m.group("val").strip().strip("'\"")
                     if m.group("tkind"):
-                        # keep the keyword with the operand ("TIME <rfc3339>"
-                        # / "DATE <date>") — conditions stay 3-tuples for
+                        # tag the operand ("\x00TIME <rfc3339>" /
+                        # "\x00DATE <date>") — conditions stay 3-tuples for
                         # every consumer, and _cmp dispatches on the tag
-                        val = f"{m.group('tkind')} {val}"
-                        if _parse_operand_time(val.split(" ", 1)[1]) is None:
+                        if _parse_operand_time(val) is None:
                             raise ValueError(f"bad {m.group('tkind')} "
                                              f"operand: {part!r}")
+                        val = f"{_TEMPORAL_TAG}{m.group('tkind')} {val}"
                     self.conditions.append((key, m.group("op"), val))
 
     @staticmethod
     def _cmp(op: str, x: str, v: str) -> bool:
-        if v.startswith(("TIME ", "DATE ")):
+        if v.startswith(_TEMPORAL_TAG):
             # temporal comparison (reference query.go matchValue time case):
             # the event value parses as RFC3339 when it contains 'T', else
             # as a plain date; unparseable values never match

@@ -127,3 +127,30 @@ def test_device_mod_l_reduction_matches_host():
     hw_host = sc.comb_windows(host)
     hw_dev = np.asarray(edp._windows_from_limbs12(jnp.asarray(dev)))
     assert (hw_host == hw_dev.T).all()
+
+
+def test_sha512_rab_uniform_lengths_cross_padding_boundaries():
+    """Regression (round-5 review): the 4-way AVX2 SHA-512 lanes only fire
+    for quads of EQUAL message length, so every length must be tested
+    uniformly — and (64 + mlen) % 128 == 112 (mlen = 48 mod 128) is the
+    exact padding boundary where the 0x80 byte needs a whole extra block
+    (the original nblk formula overwrote the length field instead)."""
+    import hashlib
+    import random
+
+    import numpy as np
+
+    from tendermint_tpu.ops import chash
+
+    rng = random.Random(11)
+    for L in (0, 1, 47, 48, 49, 63, 64, 111, 112, 113, 127, 128,
+              175, 176, 177, 304, 432, 944):
+        n = 8
+        r32 = np.frombuffer(rng.randbytes(32 * n), np.uint8).reshape(n, 32)
+        a32 = np.frombuffer(rng.randbytes(32 * n), np.uint8).reshape(n, 32)
+        msgs = [rng.randbytes(L) for _ in range(n)]
+        got = chash.sha512_rab(np.ascontiguousarray(r32),
+                               np.ascontiguousarray(a32), msgs)
+        for i in range(n):
+            exp = hashlib.sha512(bytes(r32[i]) + bytes(a32[i]) + msgs[i]).digest()
+            assert bytes(got[i]) == exp, f"L={L} lane {i}"
