@@ -84,17 +84,20 @@ class _KernelBatchVerifier(BatchVerifier):
     def add(self, pub_key: keys.PubKey, msg: bytes, sig: bytes) -> None:
         self._items.append((pub_key.bytes(), msg, sig))
 
-    def dispatch(self):
+    def dispatch(self, force_device: bool = False):
         """Issue host prep + device dispatch without fetching. Returns
         (device_out_or_None, resolve) where resolve(fetched) -> (all_ok,
         bitmap); fetch device_out with jax.device_get. Small batches verify
-        scalar immediately (device_out None)."""
+        scalar immediately (device_out None). force_device=True pins the
+        device kernel regardless of the host crossover (pipelined callers
+        whose chunks overlap other host work)."""
         import importlib
 
         items, self._items = self._items, []
         from tendermint_tpu.ops import chost
 
-        if (len(items) < batch_min(self._batch_min_default)
+        if (not force_device
+                and len(items) < batch_min(self._batch_min_default)
                 and not chost.available()):
             # Pure-Python scalar fallback only when the C host verifier is
             # missing: with it, the ops dispatch routes ANY size to the host
@@ -108,7 +111,7 @@ class _KernelBatchVerifier(BatchVerifier):
 
         ops = importlib.import_module(self._ops_module)
         started = _t.monotonic()
-        dev, finish = ops.dispatch_batch(items)
+        dev, finish = ops.dispatch_batch(items, force_device=force_device)
 
         def resolve(fetched):
             out = [bool(b) for b in finish(fetched)]
@@ -167,7 +170,7 @@ class MixedBatchVerifier(BatchVerifier):
         self._order.append((kt, len(sub)))
         sub.add(pub_key, msg, sig)
 
-    def dispatch(self):
+    def dispatch(self, force_device: bool = False):
         """Issue every key type's dispatch without fetching. Returns
         (devs, resolve) where devs is a list of device arrays (None entries
         for host-resolved sub-batches) and resolve(jax.device_get(devs)) ->
@@ -177,7 +180,7 @@ class MixedBatchVerifier(BatchVerifier):
         pairs = []
         for kt, sub in self._subs.items():
             if hasattr(sub, "dispatch"):
-                pairs.append((kt,) + sub.dispatch())
+                pairs.append((kt,) + sub.dispatch(force_device=force_device))
             else:
                 res = sub.verify()
                 pairs.append((kt, None, lambda _fetched, _res=res: _res))

@@ -242,10 +242,13 @@ def _lt_p(s_le: np.ndarray) -> np.ndarray:
     return sc.lt_bound(s_le, _P_BYTES_BE)
 
 
-def dispatch_batch(items: list[tuple[bytes, bytes, bytes]]):
+def dispatch_batch(items: list[tuple[bytes, bytes, bytes]],
+                   force_device: bool = False):
     """Async batched verify (same contract as ed25519_batch.dispatch_batch):
     returns (device_out, finish) with nothing fetched, so mixed-key commits
-    overlap the ed25519 and sr25519 readbacks in one device_get."""
+    overlap the ed25519 and sr25519 readbacks in one device_get.
+    force_device=True skips the host route (callers that pipeline
+    sub-crossover chunks against device flights)."""
     if not items:
         return None, lambda _: np.zeros((0,), dtype=bool)
     n = len(items)
@@ -264,7 +267,7 @@ def dispatch_batch(items: list[tuple[bytes, bytes, bytes]]):
     pubs32, pub_size_ok = edb._normalize_pubs([it[0] for it in items])
     pubs_arr = np.frombuffer(pubs32, dtype=np.uint8).reshape(n, 32)
 
-    if n < edb.host_crossover():
+    if not force_device and n < edb.host_crossover():
         # Same crossover as ed25519: a kernel flush below it loses to the C
         # host verifier (ops/chost does its own ristretto decodes + s<L).
         from tendermint_tpu.ops import chost
