@@ -896,13 +896,19 @@ static u64 fnv1a(const u8 *k, int n) {
     return h;
 }
 
+static acache_entry *RCACHE; /* same shape, ristretto-decoded sr25519 keys */
+
 /* decompress A (cached); returns 1 ok (fills affine -A niels + affine A),
- * 0 bad key */
-static int acache_get(const u8 pub[32], nielspt *neg_niels, fe *ax, fe *ay) {
+ * 0 bad key.  kind 0 = ed25519 RFC 8032 decode, 1 = ristretto255 decode
+ * (validator keys repeat every height for both types). */
+static int acache_get_kind(const u8 pub[32], nielspt *neg_niels, fe *ax,
+                           fe *ay, int kind) {
     pthread_mutex_lock(&ACACHE_MU);
-    if (!ACACHE) ACACHE = (acache_entry *)calloc(ACACHE_SLOTS, sizeof(acache_entry));
+    acache_entry **cachep = kind ? &RCACHE : &ACACHE;
+    if (!*cachep) *cachep = (acache_entry *)calloc(ACACHE_SLOTS, sizeof(acache_entry));
+    acache_entry *CACHE = *cachep;
     u64 slot = fnv1a(pub, 32) & (ACACHE_SLOTS - 1);
-    acache_entry *e = &ACACHE[slot];
+    acache_entry *e = &CACHE[slot];
     if (e->state && memcmp(e->key, pub, 32) == 0) {
         int ok = e->state == 1;
         if (ok) {
@@ -915,7 +921,15 @@ static int acache_get(const u8 pub[32], nielspt *neg_niels, fe *ax, fe *ay) {
     }
     pthread_mutex_unlock(&ACACHE_MU);
     fe x, y;
-    int ok = ed_decompress(&x, &y, pub);
+    int ok;
+    if (kind) {
+        ge A;
+        ok = ristretto_decode_c(&A, pub);
+        x = A.X;
+        y = A.Y;
+    } else {
+        ok = ed_decompress(&x, &y, pub);
+    }
     acache_entry ne;
     memset(&ne, 0, sizeof(ne));
     memcpy(ne.key, pub, 32);
@@ -934,9 +948,13 @@ static int acache_get(const u8 pub[32], nielspt *neg_niels, fe *ax, fe *ay) {
         ne.state = 2;
     }
     pthread_mutex_lock(&ACACHE_MU);
-    ACACHE[slot] = ne; /* lossy overwrite on collision */
+    CACHE[slot] = ne; /* lossy overwrite on collision */
     pthread_mutex_unlock(&ACACHE_MU);
     return ok;
+}
+
+static int acache_get(const u8 pub[32], nielspt *neg_niels, fe *ax, fe *ay) {
+    return acache_get_kind(pub, neg_niels, ax, ay, 0);
 }
 
 /* ------------------------------------------------------------------ */
@@ -1008,12 +1026,13 @@ static int ed_verify_one(const u8 pub[32], const u8 h32[32], const u8 s32[32],
 static int sr_verify_one(const u8 pub[32], const u8 c32[32], const u8 s32[32],
                          const u8 r32[32]) {
     if (!sc_is_lt_l(s32)) return 0;
-    ge A, R;
-    if (!ristretto_decode_c(&A, pub)) return 0;
+    fe ax, ay;
+    ge R;
+    if (!acache_get_kind(pub, NULL, &ax, &ay, 1)) return 0;
     if (!ristretto_decode_c(&R, r32)) return 0;
     /* Q = [s]B + [c](-A); accept iff Q ~ R (ristretto coset equality) */
     ge acc;
-    straus_sb_ha(&acc, &A.X, &A.Y, s32, c32);
+    straus_sb_ha(&acc, &ax, &ay, s32, c32);
     return ristretto_eq_c(&acc, &R);
 }
 
@@ -1225,14 +1244,11 @@ void sr25519h_verify(long n, const u8 *pubs, const u8 *c32, const u8 *s32,
     fe *ay = ax + n, *rx = ax + 2 * n, *ry = ax + 3 * n;
     for (long i = 0; i < n; i++) {
         int ok = valid[i] && sc_is_lt_l(s32 + 32 * i);
+        if (ok) ok = acache_get_kind(pubs + 32 * i, NULL, &ax[i], &ay[i], 1);
         if (ok) {
-            ge A, R;
-            ok = ristretto_decode_c(&A, pubs + 32 * i) &&
-                 ristretto_decode_c(&R, r32 + 32 * i);
-            if (ok) {
-                ax[i] = A.X; ay[i] = A.Y;
-                rx[i] = R.X; ry[i] = R.Y;
-            }
+            ge R;
+            ok = ristretto_decode_c(&R, r32 + 32 * i);
+            if (ok) { rx[i] = R.X; ry[i] = R.Y; }
         }
         item_ok[i] = (u8)ok;
     }
