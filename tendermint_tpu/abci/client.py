@@ -16,6 +16,7 @@ import time
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.abci import wire
+from tendermint_tpu.utils import faults
 
 
 class ABCIClientError(Exception):
@@ -67,6 +68,7 @@ class ABCISocketClient:
                 self._sock = None
 
     def _call(self, kind: str, req=None):
+        faults.fire("abci.call")
         with self._mtx:
             if self._sock is None:
                 raise ABCIClientError("client is closed")

@@ -413,6 +413,12 @@ class ConsensusState:
         the serial drain's."""
         from tendermint_tpu.crypto import batch as crypto_batch
 
+        # Apply the in-flight previous flush FIRST: if it commits and
+        # advances the height, a snapshot taken before it would filter every
+        # vote of this batch against the stale height and silently demote
+        # the whole drain to serial verification exactly on the busiest
+        # transition (ADVICE r5 item 3).
+        self._flush_pending_votes(_locked=True)
         rs = self.rs
         val_set = rs.votes.val_set if rs.votes is not None else None
         height = rs.height
@@ -438,17 +444,12 @@ class ConsensusState:
                 verifier.add(val.pub_key, sb, v.signature)
                 queued.append(i)
             if not queued:
-                # still apply batch k first: arrival order
-                self._flush_pending_votes(_locked=True)
                 self._apply_vote_results(msgs, {})
                 return
             devs, resolve = verifier.dispatch()
             has_device = any(
                 d is not None
                 for d in (devs if isinstance(devs, list) else [devs]))
-            # batch k+1 is now in flight; apply batch k (arrival order)
-            # while it travels
-            self._flush_pending_votes(_locked=True)
             if has_device:
                 # stash; the drain loop applies it before the next state
                 # transition, overlapping the round trip with more draining
@@ -463,7 +464,6 @@ class ConsensusState:
             if self.logger is not None:
                 self.logger.error("batched vote verify failed; falling back "
                                   "to serial", err=e)
-            self._flush_pending_votes(_locked=True)
         self._apply_vote_results(msgs, ok_by_i)
 
     def _flush_pending_votes(self, _locked: bool = False) -> None:
@@ -747,10 +747,16 @@ class ConsensusState:
                             block_id=prop_block_id, timestamp=Time.now())
         try:
             self.priv_validator.sign_proposal(self.state.chain_id, proposal)
-        except Exception:  # noqa: BLE001 - failed signing is non-fatal
-            if not self.replay_mode:
-                return
-            raise
+        except Exception as e:  # noqa: BLE001 - failed signing is non-fatal
+            # Non-fatal in BOTH modes (reference: state.go:1124-1180 logs
+            # outside replay, stays silent inside it). In catchup replay
+            # after a crash that lost WAL frames past the last signed step,
+            # the double-sign guard refuses this HRS -- the node must skip
+            # proposing and let the next round proceed, not die here.
+            if not self.replay_mode and self.logger is not None:
+                self.logger.error("error signing proposal", height=height,
+                                  round=round_, err=e)
+            return
         msgs = [MsgInfo(ProposalMessage(proposal), "")]
         for i in range(block_parts.header().total):
             part = block_parts.get_part(i)
@@ -973,18 +979,21 @@ class ConsensusState:
             raise ConsensusError("cannot finalize commit; proposal block does not hash to commit hash")
         self.block_exec.validate_block(self.state, block)
 
-        from tendermint_tpu.utils import fail
+        from tendermint_tpu.utils import faults
 
-        fail.fail_point()  # crash site 1 (reference: state.go:1605)
+        # crash site 1 (reference: state.go:1605)
+        faults.fail_point("consensus.finalize.save_block")
         if self.block_store.height < block.header.height:
             seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
             self.block_store.save_block(block, block_parts, seen_commit)
 
-        fail.fail_point()  # crash site 2 (reference: state.go:1619)
+        # crash site 2 (reference: state.go:1619)
+        faults.fail_point("consensus.finalize.end_height")
         if self.wal is not None:
             self.wal.write_sync(EndHeightMessage(height), _time.time_ns())
 
-        fail.fail_point()  # crash site 3 (reference: state.go:1642)
+        # crash site 3 (reference: state.go:1642)
+        faults.fail_point("consensus.finalize.apply_block")
         state_copy = self.state.copy()
         state_copy, retain_height = self.block_exec.apply_block(
             state_copy,
@@ -992,7 +1001,8 @@ class ConsensusState:
             block,
         )
 
-        fail.fail_point()  # crash site 4 (reference: state.go:1667)
+        # crash site 4 (reference: state.go:1667)
+        faults.fail_point("consensus.finalize.prune")
         if retain_height > 0:
             try:
                 self.block_store.prune_blocks(retain_height)
@@ -1001,7 +1011,8 @@ class ConsensusState:
 
         self.update_to_state(state_copy)
 
-        fail.fail_point()  # crash site 5 (reference: state.go:1685)
+        # crash site 5 (reference: state.go:1685)
+        faults.fail_point("consensus.finalize.done")
         if self.priv_validator is not None:
             self.priv_validator_pub_key = self.priv_validator.get_pub_key()
         self._schedule_round_0()

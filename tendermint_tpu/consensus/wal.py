@@ -18,6 +18,7 @@ import zlib
 from dataclasses import dataclass
 
 from tendermint_tpu.encoding import proto
+from tendermint_tpu.utils import faults
 
 MAX_MSG_SIZE_BYTES = 1024 * 1024  # reference: consensus/wal.go:32
 DEFAULT_HEAD_SIZE_LIMIT = 10 * 1024 * 1024
@@ -218,6 +219,7 @@ class WAL:
     def write_sync(self, msg, time_ns: int = 0) -> None:
         with self._mtx:
             self._write_locked(msg, time_ns)
+            faults.fire("wal.fsync")  # crash here loses the buffered frames
             self._head.flush()
             os.fsync(self._head.fileno())
 
@@ -226,11 +228,16 @@ class WAL:
         if len(body) > MAX_MSG_SIZE_BYTES:
             raise WALError(f"msg is too big: {len(body)} bytes, max: {MAX_MSG_SIZE_BYTES} bytes")
         crc = zlib.crc32(body) & 0xFFFFFFFF
-        self._head.write(struct.pack(">II", crc, len(body)) + body)
+        frame = struct.pack(">II", crc, len(body)) + body
+        # torn/partial rules write a cut prefix of this frame and crash,
+        # leaving on disk exactly what a power cut mid-append leaves.
+        faults.torn_write("wal.write", self._head, frame)
+        self._head.write(frame)
         self._maybe_rotate()
 
     def flush_and_sync(self) -> None:
         with self._mtx:
+            faults.fire("wal.fsync")
             self._head.flush()
             os.fsync(self._head.fileno())
 

@@ -11,11 +11,14 @@ front.
 every commit's serial-semantics signature prefix into ONE BatchVerifier flush
 (one wide TPU kernel launch), then replays each header's serial accept/reject
 decision over the returned bitmap. The overall accept/reject matches running
-verify_adjacent per header; the one reporting difference is that a structural
-defect anywhere in the range is detected in the host pass and therefore
-reported before a bad SIGNATURE at an earlier height (a sequential loop would
-hit the earlier signature first). Chains that a sequential loop accepts are
-accepted with identical side effects.
+verify_adjacent per header; the one reporting difference is error ORDERING:
+a structural defect anywhere in the range is detected in the host pass and
+therefore reported before a bad SIGNATURE at an earlier height (a sequential
+loop would hit the earlier signature first) -- and the set-size check
+(len(signatures) == validator set size) runs even earlier, in the dispatch
+phase, so a set-size mismatch at a LATER height is reported before any
+structural or signature error at an earlier one. Chains that a sequential
+loop accepts are accepted with identical side effects.
 """
 
 from __future__ import annotations
@@ -59,9 +62,10 @@ def verify_header_range(trusted: LightBlock, chain: list[LightBlock],
     # Phase 1 (DISPATCH): collect signature items and dispatch them in
     # chunks as early as possible -- the tunnel's ~90 ms round trip is pure
     # latency, so results dispatched now travel home (copy_to_host_async in
-    # ops dispatch) while phase 2 validates structure on host.  Chunks sit
-    # just above the host/kernel crossover so they take the ASYNC device
-    # path; the sub-crossover tail runs on host CPU under the same flights.
+    # ops dispatch) while phase 2 validates structure on host.  EVERY chunk,
+    # including the sub-crossover tail, is dispatched with
+    # force_device=use_device, so once the range is device-sized the tail
+    # flies with the other chunks instead of burning synchronous host CPU.
     from tendermint_tpu.ops import ed25519_batch as _edb
 
     # Split into EVEN device chunks of ~2,500 signatures (measured sweet

@@ -310,6 +310,12 @@ class Node:
     # --- lifecycle (reference: node/node.go:941 OnStart) -------------------
 
     def start(self) -> None:
+        # Chaos layer: (re)load TMTPU_FAULTS/TMTPU_FAULT_SEED so every node
+        # process starts its fault-site hit counters from zero -- a crash
+        # matrix run is then replayable from the env spec + seed alone.
+        from tendermint_tpu.utils import faults
+
+        faults.install_from_env()
         # AOT-warm the batch-verify kernel off the critical path so the first
         # real commit at a warm bucket size is a compile-cache hit
         # (reference has no analogue; XLA compilation is TPU-build-specific).

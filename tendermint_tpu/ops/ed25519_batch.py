@@ -43,11 +43,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tendermint_tpu.utils import jaxcache
+from tendermint_tpu.utils import faults, jaxcache
 
 jaxcache.enable()
 
 from tendermint_tpu.crypto import ed25519 as ref
+from tendermint_tpu.ops import breaker as _cbreaker
 from tendermint_tpu.ops import chash
 from tendermint_tpu.ops import edwards25519 as ed
 from tendermint_tpu.ops import scalar25519 as sc
@@ -126,8 +127,13 @@ def _verify_kernel(tab, h_win, s_win, r_y, r_sign, valid, axis_name=None):
     acc0 = ed.identity((n,))
     if axis_name is not None:
         # Mark the loop carry device-varying under shard_map (pvary was
-        # deprecated in favour of pcast in jax 0.9).
-        acc0 = jax.lax.pcast(acc0, axis_name, to="varying")
+        # deprecated in favour of pcast in jax 0.9; jax < 0.5 has neither
+        # and needs no marking -- varying-manual-axes tracking didn't exist).
+        pcast = getattr(jax.lax, "pcast", None)
+        if pcast is not None:
+            acc0 = pcast(acc0, axis_name, to="varying")
+        elif hasattr(jax.lax, "pvary"):
+            acc0 = jax.lax.pvary(acc0, axis_name)
     acc = jax.lax.fori_loop(0, 64, body, acc0)
 
     y, sign = ed.compress_canonical(acc)
@@ -523,7 +529,9 @@ def calibrate_host_crossover(device_marginal_us: float = 2.5) -> int:
     with _HOST_CAL_LOCK:
         if _HOST_CAL["crossover"] is not None:
             return _HOST_CAL["crossover"]
-        if not chost.available():
+        # ensure_available: calibration runs in the warmup background
+        # thread, the designated place to pay the gcc build once.
+        if not chost.ensure_available():
             _HOST_CAL["crossover"] = 0
             return 0
         import time as _t
@@ -577,33 +585,32 @@ def _dispatch_host(items, n):
     return None, lambda _unused: bitmap
 
 
-def dispatch_batch(items: list[tuple[bytes, bytes, bytes]],
-                   force_device: bool = False):
-    """Async batched verify of [(pub, msg, sig)]: all host prep + device
-    dispatches are issued, nothing is fetched. Returns (device_out, finish)
-    where `finish(jax.device_get(device_out))` -> (len(items),) bool. Lets
-    callers (MixedBatchVerifier) overlap the fetch latency of several
-    kernels in ONE device_get -- the tunnel round trip is latency-bound, so
-    two sequential fetches cost two floors, one batched fetch costs one.
+def _scalar_fallback_bitmap(items) -> np.ndarray:
+    """Pure-Python serial re-verification: the degradation floor that needs
+    neither the device nor the C library (used while the C build is in
+    flight and as the last rung of the circuit-breaker fallback)."""
+    return np.fromiter((ref.verify(p, m, s) for (p, m, s) in items),
+                       dtype=bool, count=len(items))
 
-    Routes to the C host verifier below the measured crossover (ops/chost),
-    else the fused Pallas kernel on TPU (ops/ed25519_pallas), the shard_map
-    multi-device path when a mesh is present, or the pure-jnp CPU fallback.
-    force_device=True skips the host route (kernel warmup, kernel tests)."""
-    if not items:
-        return None, lambda _: np.zeros((0,), dtype=bool)
-    n = len(items)
-    ndev = len(jax.devices())
-    multichip = (ndev > 1 and n >= ndev * MIN_BUCKET
-                 and os.environ.get("TM_TPU_DISABLE_SHARD") != "1")
-    if not multichip and not force_device and n < host_crossover():
-        # Below the measured crossover a kernel flush loses to the CPU: the
-        # sync floor alone exceeds the C verifier's whole runtime. No device
-        # tables are built on this path (host verification is self-contained).
-        from tendermint_tpu.ops import chost
 
-        if chost.available():
-            return _dispatch_host(items, n)
+def _host_fallback(items, n):
+    """(device_out=None, finish) via the best available host path: the C
+    verifier when loaded, else the pure-Python scalar loop."""
+    from tendermint_tpu.ops import chost
+
+    if chost.available():
+        return _dispatch_host(items, n)
+    bitmap = _scalar_fallback_bitmap(items)
+    return None, lambda _unused: bitmap
+
+
+def _dispatch_device(items, n: int, multichip: bool):
+    """The accelerator route proper: comb tables + Pallas / shard_map / jnp
+    kernel dispatch. Raises on device failure (injected or real); the
+    circuit breaker in dispatch_batch owns the fallback. The fault site
+    fires in dispatch_batch, NOT here: the breaker probe also runs this
+    function, and probe timing must never consume the deterministic
+    consensus-path hit indices of ops.ed25519.device."""
     ks, key_idx, pub_ok = get_keyset([it[0] for it in items])
     # Non-decompressable keys get an identity comb table; they must be
     # rejected here, exactly as the scalar path's _decompress(pub) is None.
@@ -647,6 +654,70 @@ def dispatch_batch(items: list[tuple[bytes, bytes, bytes]],
     return ok, lambda v: np.asarray(v)[:n].astype(bool)
 
 
+def _device_probe() -> bool:
+    """Circuit-breaker probe: one real signature through the device route.
+    Runs in the breaker's background thread, never on the consensus path.
+    Fires its own fault site (keep a dead-device simulation dead with
+    TMTPU_FAULTS="ops.ed25519.device:raise,ops.ed25519.probe:raise")."""
+    faults.fire("ops.ed25519.probe")
+    priv = ref.gen_priv_key(b"\x7b" * 32)
+    items = [(priv.pub_key().data, b"breaker-probe",
+              ref.sign(priv.data, b"breaker-probe"))]
+    dev, finish = _dispatch_device(items, 1, multichip=False)
+    return bool(np.all(finish(jax.device_get(dev))))
+
+
+BREAKER = _cbreaker.CircuitBreaker("ed25519-device", probe=_device_probe)
+
+
+def dispatch_batch(items: list[tuple[bytes, bytes, bytes]],
+                   force_device: bool = False):
+    """Async batched verify of [(pub, msg, sig)]: all host prep + device
+    dispatches are issued, nothing is fetched. Returns (device_out, finish)
+    where `finish(jax.device_get(device_out))` -> (len(items),) bool. Lets
+    callers (MixedBatchVerifier) overlap the fetch latency of several
+    kernels in ONE device_get -- the tunnel round trip is latency-bound, so
+    two sequential fetches cost two floors, one batched fetch costs one.
+
+    Routes to the C host verifier below the measured crossover (ops/chost),
+    else the fused Pallas kernel on TPU (ops/ed25519_pallas), the shard_map
+    multi-device path when a mesh is present, or the pure-jnp CPU fallback.
+    force_device=True skips the host route (kernel warmup, kernel tests).
+
+    The device route sits behind a circuit breaker (ops/breaker): a device
+    dispatch failure is re-verified on the host within the same call, the
+    circuit opens, and later batches go straight to the host until a
+    background probe re-closes it -- consensus keeps committing with a dead
+    accelerator. While open, even force_device callers are degraded."""
+    if not items:
+        return None, lambda _: np.zeros((0,), dtype=bool)
+    n = len(items)
+    ndev = len(jax.devices())
+    multichip = (ndev > 1 and n >= ndev * MIN_BUCKET
+                 and os.environ.get("TM_TPU_DISABLE_SHARD") != "1")
+    if not multichip and not force_device and n < host_crossover():
+        # Below the measured crossover a kernel flush loses to the CPU: the
+        # sync floor alone exceeds the C verifier's whole runtime. No device
+        # tables are built on this path (host verification is self-contained).
+        from tendermint_tpu.ops import chost
+
+        if chost.available():
+            return _dispatch_host(items, n)
+        if chost.building():
+            # The gcc build is in flight: serial Python (~2 ms/sig, bounded
+            # by the build window) beats the alternative -- on a cold
+            # process the device route here means a fresh XLA compile, an
+            # order of magnitude worse than scalar-verifying these batches.
+            # (_host_fallback resolves to the scalar loop while building.)
+            return _host_fallback(items, n)
+    def _device():
+        faults.fire("ops.ed25519.device")
+        return _dispatch_device(items, n, multichip)
+
+    return _cbreaker.guarded_dispatch(
+        BREAKER, _device, lambda: _host_fallback(items, n))
+
+
 def _start_host_copy(dev) -> None:
     """Begin the D2H transfer NOW: over this host's tunnel a device_get
     issued after the command stream drains pays a fresh ~90 ms round trip
@@ -663,4 +734,5 @@ def verify_batch(items: list[tuple[bytes, bytes, bytes]],
                  force_device: bool = False) -> np.ndarray:
     """Batched verify of [(pub, msg, sig)]; returns (len(items),) bool."""
     dev, finish = dispatch_batch(items, force_device=force_device)
-    return finish(jax.device_get(dev) if dev is not None else None)
+    return _cbreaker.guarded_fetch(
+        BREAKER, dev, finish, lambda: _host_fallback(items, len(items)))

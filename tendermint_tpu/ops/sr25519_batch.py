@@ -42,7 +42,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from tendermint_tpu.crypto import sr25519 as srref
+from tendermint_tpu.ops import breaker as _cbreaker
 from tendermint_tpu.ops import ed25519_batch as edb
+from tendermint_tpu.utils import faults
 from tendermint_tpu.ops import edwards25519 as ed
 from tendermint_tpu.ops import field25519 as fe
 from tendermint_tpu.ops import scalar25519 as sc
@@ -242,17 +244,9 @@ def _lt_p(s_le: np.ndarray) -> np.ndarray:
     return sc.lt_bound(s_le, _P_BYTES_BE)
 
 
-def dispatch_batch(items: list[tuple[bytes, bytes, bytes]],
-                   force_device: bool = False):
-    """Async batched verify (same contract as ed25519_batch.dispatch_batch):
-    returns (device_out, finish) with nothing fetched, so mixed-key commits
-    overlap the ed25519 and sr25519 readbacks in one device_get.
-    force_device=True skips the host route (callers that pipeline
-    sub-crossover chunks against device flights)."""
-    if not items:
-        return None, lambda _: np.zeros((0,), dtype=bool)
-    n = len(items)
-
+def _parse_items(items, n: int):
+    """-> (sig_ok, marker_ok, r32, s32 (marker stripped), pubs_arr,
+    pub_size_ok): the structural prechecks every route shares."""
     sig_ok = np.fromiter(
         (len(it[2]) == srref.SIGNATURE_SIZE for it in items), dtype=bool, count=n)
     zero64 = b"\x00" * 64
@@ -263,21 +257,38 @@ def dispatch_batch(items: list[tuple[bytes, bytes, bytes]],
     s32 = np.ascontiguousarray(sigs[:, 32:]).copy()
     marker_ok = (s32[:, 31] & 128) != 0  # schnorrkel v1 marker bit
     s32[:, 31] &= 127
-
     pubs32, pub_size_ok = edb._normalize_pubs([it[0] for it in items])
     pubs_arr = np.frombuffer(pubs32, dtype=np.uint8).reshape(n, 32)
+    return sig_ok, marker_ok, r32, s32, pubs_arr, pub_size_ok
 
-    if not force_device and n < edb.host_crossover():
-        # Same crossover as ed25519: a kernel flush below it loses to the C
-        # host verifier (ops/chost does its own ristretto decodes + s<L).
-        from tendermint_tpu.ops import chost
 
-        if chost.available():
-            k32 = challenges([it[1] for it in items], pubs_arr, r32)
-            bitmap = chost.sr25519_verify(
-                pubs_arr, k32, s32, r32, sig_ok & marker_ok & pub_size_ok)
-            return None, lambda _unused: bitmap
+def _scalar_fallback_bitmap(items) -> np.ndarray:
+    """Pure-Python serial re-verification (the degradation floor)."""
+    return np.fromiter((srref.verify(p, m, s) for (p, m, s) in items),
+                       dtype=bool, count=len(items))
 
+
+def _host_fallback(items, n):
+    """(device_out=None, finish) via the C host verifier when loaded, else
+    the pure-Python scalar loop."""
+    from tendermint_tpu.ops import chost
+
+    if chost.available():
+        sig_ok, marker_ok, r32, s32, pubs_arr, pub_size_ok = _parse_items(items, n)
+        k32 = challenges([it[1] for it in items], pubs_arr, r32)
+        bitmap = chost.sr25519_verify(
+            pubs_arr, k32, s32, r32, sig_ok & marker_ok & pub_size_ok)
+    else:
+        bitmap = _scalar_fallback_bitmap(items)
+    return None, lambda _unused: bitmap
+
+
+def _dispatch_device(items, n: int):
+    """The accelerator route proper; raises on device failure (injected or
+    real) -- the circuit breaker in dispatch_batch owns the fallback. The
+    fault site fires in dispatch_batch, not here, so the breaker probe
+    never consumes consensus-path hit indices (see the ed25519 twin)."""
+    sig_ok, marker_ok, r32, s32, pubs_arr, _pub_size_ok = _parse_items(items, n)
     ks, key_idx, pub_ok = get_keyset([it[0] for it in items])
     pub_ok = pub_ok & ks.valid[key_idx]
     s_ok = sc.lt_l(s32)
@@ -319,8 +330,54 @@ def dispatch_batch(items: list[tuple[bytes, bytes, bytes]],
     return ok, lambda v: np.asarray(v)[:n]
 
 
+def _device_probe() -> bool:
+    """Circuit-breaker probe: one real signature through the device route
+    (breaker background thread, never the consensus path); fires its own
+    fault site, ops.sr25519.probe."""
+    faults.fire("ops.sr25519.probe")
+    priv = srref.gen_priv_key(b"\x7c" * 32)
+    items = [(priv.pub_key().data, b"breaker-probe",
+              srref.sign(priv.data, b"breaker-probe"))]
+    dev, finish = _dispatch_device(items, 1)
+    return bool(np.all(finish(jax.device_get(dev))))
+
+
+BREAKER = _cbreaker.CircuitBreaker("sr25519-device", probe=_device_probe)
+
+
+def dispatch_batch(items: list[tuple[bytes, bytes, bytes]],
+                   force_device: bool = False):
+    """Async batched verify (same contract as ed25519_batch.dispatch_batch):
+    returns (device_out, finish) with nothing fetched, so mixed-key commits
+    overlap the ed25519 and sr25519 readbacks in one device_get.
+    force_device=True skips the host route (callers that pipeline
+    sub-crossover chunks against device flights). The device route sits
+    behind the same circuit-breaker degradation as the ed25519 twin."""
+    if not items:
+        return None, lambda _: np.zeros((0,), dtype=bool)
+    n = len(items)
+
+    if not force_device and n < edb.host_crossover():
+        # Same crossover as ed25519: a kernel flush below it loses to the C
+        # host verifier (ops/chost does its own ristretto decodes + s<L).
+        from tendermint_tpu.ops import chost
+
+        if chost.available() or chost.building():
+            # While the C build is in flight this degrades to the pure-
+            # Python loop: bounded by the build window, and still cheaper
+            # than a cold-process XLA compile of the kernel.
+            return _host_fallback(items, n)
+    def _device():
+        faults.fire("ops.sr25519.device")
+        return _dispatch_device(items, n)
+
+    return _cbreaker.guarded_dispatch(
+        BREAKER, _device, lambda: _host_fallback(items, n))
+
+
 def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
     """Batched verify of [(pub, msg, sig)]; returns (len(items),) bool,
     byte-identical accept/reject with crypto/sr25519.verify."""
     dev, finish = dispatch_batch(items)
-    return finish(jax.device_get(dev) if dev is not None else None)
+    return _cbreaker.guarded_fetch(
+        BREAKER, dev, finish, lambda: _host_fallback(items, len(items)))

@@ -18,6 +18,7 @@ import time
 from dataclasses import dataclass, field
 
 from tendermint_tpu.encoding import proto
+from tendermint_tpu.utils import faults
 from tendermint_tpu.utils.flowrate import Monitor
 
 MAX_PACKET_MSG_PAYLOAD_SIZE = 1024
@@ -112,6 +113,15 @@ class MConnection:
         """Queue a message on a channel (reference: connection.go:250-290)."""
         ch = self._channels.get(ch_id)
         if ch is None or not self._running:
+            return False
+        try:
+            if faults.maybe_drop("p2p.send"):
+                return True  # loss after send: the caller sees success
+        except faults.FaultDisconnect as e:
+            # documented disconnect semantics: a transport-style teardown
+            # (peer removal + reconnect), never an exception into the
+            # arbitrary sending thread (gossip loops have no handler)
+            self._die(e)
             return False
         try:
             ch.send_queue.put(msg, block=block, timeout=10 if block else None)
@@ -226,7 +236,10 @@ class MConnection:
                     if eof:
                         msg = bytes(ch.recving)
                         ch.recving = bytearray()
-                        self._on_receive(ch_id, msg)
+                        # drop skips delivery; disconnect raises into _die,
+                        # which tears the peer down like a transport error
+                        if not faults.maybe_drop("p2p.recv"):
+                            self._on_receive(ch_id, msg)
                 self._last_recv = time.monotonic()
         except Exception as e:  # noqa: BLE001
             self._die(e)

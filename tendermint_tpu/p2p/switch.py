@@ -9,15 +9,21 @@ reconnect-to-persistent-peers.
 
 from __future__ import annotations
 
+import random
 import socket
 import threading
 import time
 
+from typing import TYPE_CHECKING
+
 from tendermint_tpu.encoding import proto
+from tendermint_tpu.utils import faults
 from tendermint_tpu.p2p.connection import ChannelDescriptor, MConnection
 from tendermint_tpu.p2p.key import NodeKey
 from tendermint_tpu.p2p.node_info import NodeInfo
-from tendermint_tpu.p2p.secret_connection import SecretConnection
+
+if TYPE_CHECKING:
+    from tendermint_tpu.p2p.secret_connection import SecretConnection
 
 
 class P2PError(Exception):
@@ -125,11 +131,17 @@ class Transport:
         return self._upgrade(raw, f"{addr[0]}:{addr[1]}")
 
     def dial(self, addr: str) -> tuple[SecretConnection, NodeInfo, str]:
+        faults.fire("p2p.dial")
         host, port = _split_addr(addr)
         raw = socket.create_connection((host, port), timeout=self.dial_timeout_s)
         return self._upgrade(raw, f"{host}:{port}")
 
     def _upgrade(self, raw: socket.socket, addr: str):
+        # Deferred: SecretConnection needs the optional `cryptography`
+        # package; the switch (backoff logic, registry) must import without
+        # it so hosts lacking the dep can still run non-p2p subsystems.
+        from tendermint_tpu.p2p.secret_connection import SecretConnection
+
         raw.settimeout(self.handshake_timeout_s)
         conn = SecretConnection(raw, self.node_key.priv_key)
         # NodeInfo exchange (reference: transport.go handshake)
@@ -158,6 +170,24 @@ class Transport:
                 self._listener.close()
             except OSError:
                 pass
+
+
+# Persistent-peer redial backoff (reference: p2p/switch.go:768
+# reconnectToPeer): first retry fast, then exponential with jitter so a
+# fleet of nodes redialing one restarting peer never synchronizes into a
+# dial storm. Capped low enough that a peer coming back is found quickly.
+RECONNECT_BASE_S = 0.5
+RECONNECT_MAX_S = 10.0
+RECONNECT_JITTER = 0.2
+
+
+def reconnect_backoff_s(attempt: int, rng=random) -> float:
+    """Delay before redial number ``attempt`` (0-based: the delay AFTER the
+    attempt-th consecutive failure), exponentially grown and jittered.
+    The exponent is clamped BEFORE exponentiation: 2.0**1024 overflows a
+    float, and a peer down for hours must not kill the reconnect thread."""
+    base = min(RECONNECT_BASE_S * (2.0 ** min(attempt, 16)), RECONNECT_MAX_S)
+    return base * (1.0 + RECONNECT_JITTER * rng.random())
 
 
 class Switch:
@@ -250,15 +280,42 @@ class Switch:
                 conn.close()
 
     def _reconnect_loop(self) -> None:
+        """Redial missing persistent peers with exponential backoff +
+        jitter; a successful dial (or the peer appearing inbound) resets
+        that address's schedule."""
+        attempts: dict[str, int] = {}
+        next_try: dict[str, float] = {}
         while self._running:
-            for addr in list(self._persistent_addrs):
-                node_id = addr.split("@")[0] if "@" in addr else None
-                have = node_id in self.peers if node_id else any(
-                    p.socket_addr.endswith(addr) for p in self.peers.values()
-                )
-                if not have:
-                    self.dial_peer(addr, persistent=True)
-            time.sleep(1.0)
+            try:
+                self._reconnect_pass(attempts, next_try)
+            except Exception as e:  # noqa: BLE001 - the redial thread must
+                # survive anything; losing it silently strands every
+                # persistent peer for the rest of the process lifetime
+                if self.logger:
+                    self.logger.error("reconnect pass failed", err=e)
+            time.sleep(0.25)
+
+    def _reconnect_pass(self, attempts: dict[str, int],
+                        next_try: dict[str, float]) -> None:
+        now = time.monotonic()
+        for addr in list(self._persistent_addrs):
+            node_id = addr.split("@")[0] if "@" in addr else None
+            have = node_id in self.peers if node_id else any(
+                p.socket_addr.endswith(addr) for p in self.peers.values()
+            )
+            if have:
+                attempts.pop(addr, None)
+                next_try.pop(addr, None)
+                continue
+            if now < next_try.get(addr, 0.0):
+                continue
+            if self.dial_peer(addr, persistent=True) is not None:
+                attempts.pop(addr, None)
+                next_try.pop(addr, None)
+            else:
+                k = attempts.get(addr, 0)
+                attempts[addr] = k + 1
+                next_try[addr] = time.monotonic() + reconnect_backoff_s(k)
 
     def _add_peer(self, conn, peer_info: NodeInfo, outbound: bool,
                   persistent: bool = False, socket_addr: str = "") -> Peer:

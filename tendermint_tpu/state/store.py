@@ -23,6 +23,7 @@ from tendermint_tpu.types.block_id import BlockID
 from tendermint_tpu.types.params import ConsensusParams
 from tendermint_tpu.types.ttime import Time
 from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.utils import faults
 
 _STATE_KEY = b"stateKey"
 VALSET_CHECK_INTERVAL = 100000  # reference: state/store.go valSetCheckpointInterval
@@ -135,6 +136,9 @@ class StateStore:
                               state.next_validators)
         self._save_params(next_height, state.last_height_consensus_params_changed,
                           state.consensus_params)
+        # crash between the history rows above and the state key below is
+        # the interesting torn-state case replay must absorb
+        faults.fire("store.state.save")
         self._db.set(_STATE_KEY, _marshal_state(state))
 
     def bootstrap(self, state: State) -> None:

@@ -17,6 +17,7 @@ from dataclasses import dataclass, field as dc_field
 
 from tendermint_tpu.encoding import proto
 from tendermint_tpu.store.db import DB
+from tendermint_tpu.utils import faults
 from tendermint_tpu.types.block import Block, Commit, Header
 from tendermint_tpu.types.block_id import BlockID
 from tendermint_tpu.types.part_set import Part, PartSet
@@ -169,6 +170,7 @@ class BlockStore:
             if self.base == 0:
                 self.base = height
             sets.append((_STATE_KEY, self._state_bytes()))
+            faults.fire("store.block.save")
             self._db.write_batch(sets)
 
     def save_seen_commit(self, height: int, seen_commit: Commit) -> None:

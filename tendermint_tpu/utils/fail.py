@@ -1,22 +1,16 @@
 """Crash-injection points (reference: libs/fail/fail.go:10-38).
 
-Set TMTPU_FAIL_INDEX=N to make the N-th fail_point() call in the process
-exit hard (os._exit), simulating a crash between commit steps for
-crash-consistency tests (reference call sites: state/execution.go:149-196,
-consensus/state.go:1605-1685)."""
+Superseded by the deterministic fault-injection subsystem in
+utils/faults.py; kept as a compat shim so existing call sites and the
+TMTPU_FAIL_INDEX contract (the N-th fail_point() call in the process exits
+hard) keep working unchanged. New choke points should use named sites via
+tendermint_tpu.utils.faults."""
 
 from __future__ import annotations
 
-import os
-
-_counter = 0
-
-
-def fail_point() -> None:
-    global _counter
-    target = os.environ.get("TMTPU_FAIL_INDEX")
-    if target is None:
-        return
-    if _counter == int(target):
-        os._exit(1)
-    _counter += 1
+from tendermint_tpu.utils.faults import (  # noqa: F401
+    FaultDisconnect,
+    FaultError,
+    FaultInjected,
+    fail_point,
+)

@@ -1,10 +1,21 @@
-"""Subprocess driver for the crash-recovery fail-point matrix
-(tests/test_fastsync_recovery.py). Runs a single-validator node on durable
-stores; with TMTPU_FAIL_INDEX set the node os._exit()s mid-commit at the
-chosen fail site, simulating a hard crash. In recovery mode it replays
-WAL + block store through the app and prints a JSON state summary.
+"""Subprocess driver for the crash-recovery fault matrix
+(tests/test_fastsync_recovery.py, tests/test_fault_matrix.py). Runs a
+single-validator node on durable stores; with TMTPU_FAIL_INDEX set the node
+os._exit()s mid-commit at the chosen legacy fail site, and with
+TMTPU_FAULTS/TMTPU_FAULT_SEED set the named-site chaos layer
+(tendermint_tpu/utils/faults.py) drives torn WAL writes, store-write
+crashes, etc. In recovery mode it replays WAL + block store through the app
+and prints a JSON state summary.
 
-Usage: python tests/crash_node.py <root_dir> <mode:crash|recover> <target_height>
+Usage: python tests/crash_node.py <root_dir> <mode:crash|recover> \
+           <target_height> [n_txs]
+
+With ``n_txs`` the node feeds the fixed tx universe t0..t{n-1} ("t<i>=v<i>"),
+skipping any tx already committed in the block store -- so a crash+recover
+pair applies each tx exactly once and converges to the same app hash as a
+fault-free run (the kvstore app hash is the big-endian applied-tx count).
+Without it, the legacy mode-prefixed feeding is kept for the
+TMTPU_FAIL_INDEX matrix.
 """
 
 import json
@@ -25,8 +36,32 @@ from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator  # noqa: E
 from tendermint_tpu.types.ttime import Time  # noqa: E402
 
 
+def _committed_txs(node) -> set:
+    """Every tx already in a committed block (the recovery scan that makes
+    deterministic re-feeding idempotent)."""
+    out = set()
+    for h in range(1, node.block_store.height + 1):
+        b = node.block_store.load_block(h)
+        if b is not None:
+            out.update(b.data.txs)
+    return out
+
+
+def _wait_app_settled(app, seconds: float = 1.5, budget: float = 20.0) -> None:
+    """Wait until no new txs have been applied for `seconds`: WAL replay /
+    in-flight block application must finish before the committed-tx scan,
+    or a pre-crash tx could be double-fed."""
+    stable, t_stable = app.size, time.monotonic()
+    deadline = time.monotonic() + budget
+    while time.monotonic() - t_stable < seconds and time.monotonic() < deadline:
+        if app.size != stable:
+            stable, t_stable = app.size, time.monotonic()
+        time.sleep(0.05)
+
+
 def main() -> int:
     root, mode, target_height = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    n_txs = int(sys.argv[4]) if len(sys.argv) > 4 else 0
     os.makedirs(root, exist_ok=True)
 
     pv = FilePV.load_or_generate(os.path.join(root, "pv_key.json"),
@@ -48,35 +83,60 @@ def main() -> int:
     node = Node(cfg, genesis=genesis, priv_validator=pv,
                 node_key=NodeKey(ed25519.gen_priv_key(b"\x55" * 32)))
     node.start()
+    app = node.app  # in-proc kvstore
+
+    if n_txs:
+        universe = [b"t%d=v%d" % (i, i) for i in range(n_txs)]
+        _wait_app_settled(app)
+        remaining = [tx for tx in universe if tx not in _committed_txs(node)]
+    else:
+        remaining = []
 
     # feed a tx per block so the app state actually advances
     deadline = time.monotonic() + 120
     fed = 0
     while time.monotonic() < deadline:
         h = node.block_store.height
-        if fed <= h:
-            try:
-                node.mempool.check_tx(b"%s%d=v%d" % (mode.encode(), fed, fed))
-            except Exception:  # noqa: BLE001 - dupes after replay are expected
-                pass
-            fed += 1
-        if mode == "recover" and h >= target_height:
-            break
+        if n_txs:
+            if fed < len(remaining) and fed <= h:
+                try:
+                    node.mempool.check_tx(remaining[fed])
+                except Exception:  # noqa: BLE001
+                    pass
+                fed += 1
+            if mode == "recover" and h >= target_height and app.size >= n_txs:
+                break
+            if mode == "crash" and h >= target_height + 8:
+                break  # the injected fault never fired; exit 0 so the
+                # caller's returncode assertion fails fast, not at timeout
+        else:
+            if fed <= h:
+                try:
+                    node.mempool.check_tx(b"%s%d=v%d" % (mode.encode(), fed, fed))
+                except Exception:  # noqa: BLE001 - dupes after replay are expected
+                    pass
+                fed += 1
+            if mode == "recover" and h >= target_height:
+                break
         time.sleep(0.05)
         # In crash mode the process never reaches here past the fail site:
-        # os._exit fires inside finalize_commit on the consensus thread.
+        # os._exit fires at the injected fault on the consensus thread.
     node.stop()
 
-    app = node.app  # in-proc kvstore
     st = node.state_store.load()
-    print(json.dumps({
+    summary = {
         "height": node.block_store.height,
         "state_height": st.last_block_height,
         "state_app_hash": st.app_hash.hex(),
         "app_height": app.height,
         "app_hash": app.app_hash.hex(),
         "app_size": app.size,
-    }))
+    }
+    edb = sys.modules.get("tendermint_tpu.ops.ed25519_batch")
+    if edb is not None:
+        summary["breaker_trips"] = edb.BREAKER.trips
+        summary["breaker_open"] = edb.BREAKER.is_open
+    print(json.dumps(summary))
     return 0
 
 

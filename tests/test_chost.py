@@ -20,8 +20,10 @@ from tendermint_tpu.crypto import ed25519 as ref
 from tendermint_tpu.crypto import sr25519 as srref
 from tendermint_tpu.ops import chost
 
+# ensure_available: build inline -- the non-blocking available() would
+# background the build and wrongly skip this whole module on a fresh tree.
 pytestmark = pytest.mark.skipif(
-    not chost.available(), reason="C host verifier unavailable (no g++?)")
+    not chost.ensure_available(), reason="C host verifier unavailable (no g++?)")
 
 rng = random.Random(0xC405)
 

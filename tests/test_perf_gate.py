@@ -62,14 +62,14 @@ class _FlushCounter:
         orig = cbatch._KernelBatchVerifier.dispatch
         counter = self
 
-        def counted(vself):
+        def counted(vself, force_device=False):
             small = len(vself._items) < cbatch.batch_min(
                 vself._batch_min_default)
-            if small:
+            if small and not force_device:
                 counter.scalar += 1
             else:
                 counter.kernel += 1
-            return orig(vself)
+            return orig(vself, force_device=force_device)
 
         monkeypatch.setattr(cbatch._KernelBatchVerifier, "dispatch", counted)
 
