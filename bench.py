@@ -74,8 +74,17 @@ class Rounds:
     def __init__(self):
         self.best_spin = min(_spin_ms() for _ in range(3))
 
-    def run(self, fn, iters=ITERS, rounds=ROUNDS):
+    def run(self, fn, iters=ITERS, rounds=ROUNDS, warmup_rounds=0,
+            report=None):
+        """report="min" always records min-of-rounds (the honest quiet-host
+        number for configs whose long iterations make contended rounds
+        likely); default is the headline policy (median, min under spread).
+        warmup_rounds: full measured-and-discarded rounds before recording
+        (settles page cache/allocator/JIT state beyond the single
+        throwaway call)."""
         fn()  # throwaway: settle allocator/page-cache state after generation
+        for _ in range(warmup_rounds):
+            _measure(fn, iters)
         p50s, spins, retries = [], [], 0
         while len(p50s) < rounds:
             # Spin BEFORE and AFTER: contention that starts mid-round would
@@ -96,7 +105,10 @@ class Rounds:
             p50s.append(p50)
             spins.append(round(spin, 1))
         spread = max(p50s) / min(p50s)
-        value = min(p50s) if spread > SPREAD_LIMIT else statistics.median(p50s)
+        if report == "min" or spread > SPREAD_LIMIT:
+            value = min(p50s)
+        else:
+            value = statistics.median(p50s)
         return value, dict(rounds_ms=[round(p, 1) for p in p50s],
                            spread=round(spread, 2), spins_ms=spins,
                            retries=retries)
@@ -139,23 +151,28 @@ def _mk_valset(n_ed: int, n_sr: int = 0, power: int = 10):
     return privs, vals
 
 
-def _sign_commit(header, vals, privs, chain_id=BENCH_CHAIN):
+def _sign_commit_bid(bid, height, ts, vals, privs, chain_id=BENCH_CHAIN):
     from tendermint_tpu.types.block import Commit, CommitSig
-    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
-    from tendermint_tpu.types.ttime import Time
     from tendermint_tpu.types.vote import BLOCK_ID_FLAG_COMMIT, PRECOMMIT_TYPE, Vote
 
-    bid = BlockID(hash=header.hash(),
-                  part_set_header=PartSetHeader(total=1, hash=b"\xcd" * 32))
     sigs = []
-    ts = Time(header.time.seconds, 0)
     for i, (priv, val) in enumerate(zip(privs, vals.validators)):
-        vote = Vote(type=PRECOMMIT_TYPE, height=header.height, round=1,
+        vote = Vote(type=PRECOMMIT_TYPE, height=height, round=1,
                     block_id=bid, timestamp=ts,
                     validator_address=val.address, validator_index=i)
         sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, val.address, ts,
                               priv.sign(vote.sign_bytes(chain_id))))
-    return Commit(height=header.height, round=1, block_id=bid, signatures=sigs)
+    return Commit(height=height, round=1, block_id=bid, signatures=sigs)
+
+
+def _sign_commit(header, vals, privs, chain_id=BENCH_CHAIN):
+    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+    from tendermint_tpu.types.ttime import Time
+
+    bid = BlockID(hash=header.hash(),
+                  part_set_header=PartSetHeader(total=1, hash=b"\xcd" * 32))
+    return _sign_commit_bid(bid, header.height, Time(header.time.seconds, 0),
+                            vals, privs, chain_id)
 
 
 def _gen_light_chain(n_headers: int, n_vals: int):
@@ -241,15 +258,19 @@ def config_range_verify(rr):
         # covers ~28h for 10k headers).
         verify_header_range(trusted, rest, 14 * 86400.0, now)
 
-    run()
-    value, detail = rr.run(run, iters=max(2, ITERS - 3), rounds=2)
+    # Stability (BENCH r05 spread 2.06x vs <=1.13x elsewhere): one full
+    # warmup round settles the page cache + keyset state the long
+    # iterations churn, and min-of-rounds reports the quiet-host number
+    # instead of a median poisoned by one contended round.
+    value, detail = rr.run(run, iters=max(2, ITERS - 3), rounds=2,
+                           warmup_rounds=1, report="min")
     n = len(rest)
     base = BASELINE_US_PER_SIG * n / 1000.0  # 1 sig/header serial anchor
     return dict(metric=f"range_verify_{n}_headers_p50_ms",
                 value=round(value, 1), unit="ms",
                 vs_baseline=round(base / value, 2),
                 us_per_header=round(value * 1e3 / n, 2),
-                gen_s=round(gen_s, 1), **detail)
+                gen_s=round(gen_s, 1), report="min", **detail)
 
 
 def config_mixed_commit(rr):
@@ -279,6 +300,66 @@ def config_mixed_commit(rr):
                 value=round(value, 1), unit="ms",
                 vs_baseline=round(base / value, 2),
                 blocks_per_s=round(1000.0 / value, 1),
+                gen_s=round(gen_s, 1), **detail)
+
+
+def config_fastsync(rr):
+    """BASELINE config 4 proper: fast-sync replay of mixed ed25519/sr25519
+    blocks @ 1000 validators through the verify-ahead pipeline
+    (blockchain/pipeline.py, driven by the shared headless replay harness
+    in blockchain/replay.py), reporting blocks_per_s at depth 1 (the old
+    serial loop's behavior) vs the default depth. Both depths must accept
+    the same blocks and converge to the same app hash."""
+    from tendermint_tpu.blockchain import pipeline as bpipe
+    from tendermint_tpu.blockchain.replay import ReplayCtx, make_chain
+
+    n_blocks = int(os.environ.get("BENCH_FASTSYNC_BLOCKS", 8))
+    t0 = time.monotonic()
+    privs, vals = _mk_valset(700, 300)
+    # n_blocks+1 pooled blocks -> n_blocks appliable heights
+    blocks = make_chain(BENCH_CHAIN, n_blocks + 1, vals, privs)
+    gen_s = time.monotonic() - t0
+
+    def run_depth(depth):
+        prev = os.environ.get("TM_TPU_VERIFY_AHEAD")
+        os.environ["TM_TPU_VERIFY_AHEAD"] = str(depth)
+        try:
+            ctx = ReplayCtx(vals, BENCH_CHAIN)
+            for i, b in enumerate(blocks):
+                ctx.pool.add_block("pA" if i % 2 == 0 else "pB", b)
+            pipe = bpipe.VerifyAheadPipeline()
+            while pipe.process_next(ctx):
+                pass
+            assert not ctx.punished and len(ctx.applied) == n_blocks, (
+                ctx.punished, ctx.applied)
+            return ctx
+        finally:
+            if prev is None:
+                os.environ.pop("TM_TPU_VERIFY_AHEAD", None)
+            else:
+                os.environ["TM_TPU_VERIFY_AHEAD"] = prev
+
+    depth_default = bpipe.DEFAULT_DEPTH
+    # Correctness gate (also warms kernels/keysets for both shapes):
+    # identical acceptance + app hash at depth 1 and default depth.
+    ctx1, ctxd = run_depth(1), run_depth(depth_default)
+    assert ctx1.applied == ctxd.applied and ctx1.app_hash == ctxd.app_hash
+
+    v1, _ = rr.run(lambda: run_depth(1), iters=2, rounds=2, report="min")
+    vd, detail = rr.run(lambda: run_depth(depth_default), iters=2, rounds=2,
+                        report="min")
+    bps1 = n_blocks / (v1 / 1e3)
+    bpsd = n_blocks / (vd / 1e3)
+    # serial CPU anchor: one core verifying the +2/3 light prefix per block
+    prefix_sigs = len(vals.commit_light_prefix(
+        blocks[1].last_commit, vals.total_voting_power() * 2 // 3))
+    base_bps = 1e3 / (BASELINE_US_PER_SIG * prefix_sigs / 1000.0)
+    return dict(metric=f"fastsync_1000v_mixed_{n_blocks}_blocks_per_s",
+                value=round(bpsd, 1), unit="blocks/s",
+                vs_baseline=round(bpsd / base_bps, 2),
+                depth1_blocks_per_s=round(bps1, 1),
+                speedup_vs_depth1=round(bpsd / bps1, 2),
+                depth=depth_default, prefix_sigs=prefix_sigs,
                 gen_s=round(gen_s, 1), **detail)
 
 
@@ -406,6 +487,7 @@ def main() -> None:
         ("commit150", config_commit150, (rr,)),
         ("range_verify", config_range_verify, (rr,)),
         ("mixed_commit", config_mixed_commit, (rr,)),
+        ("fastsync", config_fastsync, (rr,)),
         ("sr25519", config_sr25519, (rr,)),
         ("addvote", config_addvote, (rr,)),
     ):
@@ -428,7 +510,8 @@ def main() -> None:
         "spread": hdetail["spread"],
         "configs": {k: {kk: vv for kk, vv in v.items()
                         if kk in ("metric", "value", "unit", "vs_baseline",
-                                  "spread", "error")}
+                                  "spread", "error", "depth1_blocks_per_s",
+                                  "speedup_vs_depth1")}
                     for k, v in configs.items()},
     }
     print(json.dumps(result))

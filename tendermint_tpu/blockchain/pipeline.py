@@ -1,0 +1,212 @@
+"""Verify-ahead: the cross-decision commit-verify pipeline for fast sync.
+
+BENCH r05: the host<->device round trip (`sync_floor_ms` ~104 ms) dominates
+every verify decision — a 20,480-sig commit costs 151 ms of which ~104 ms is
+the floor, marginal cost 4.34 us/sig. The serial fast-sync loop
+(blockchain/reactor.py `_try_sync`, v1.py `try_process_block`) pays that
+floor once per block, serialized with block save/apply, so throughput is
+floor-bound no matter how fast the kernel gets.
+
+This module lifts the chunk-level pipelining of ops/ed25519_pallas
+(dispatch_items_pipelined, _start_host_copy) to DECISION granularity:
+
+  * up to depth-K blocks' commit verifications are dispatched
+    (`ValidatorSet.verify_commit_light_async`) while block h is being
+    saved/applied;
+  * readbacks of every in-flight decision are batched into ONE
+    `jax.device_get` (crypto_batch.prefetch), so K decisions pay one sync
+    floor instead of K;
+  * decisions RESOLVE strictly in height order, and each resolve replays
+    the exact serial accept/reject procedure — accept/reject and error
+    attribution are byte-identical to the serial loop.
+
+Failure semantics (identical to the serial path): a failed resolve at
+height h discards ALL speculative in-flight work, redoes the requests for
+h and h+1, and punishes the two sending peers — exactly what the serial
+loop does at the same height with the same pool contents. Speculation is
+also discarded whenever dispatch-time inputs went stale: the pool's blocks
+at the entry's heights changed (peer churn, redo), or the validator set
+hash changed after an apply (validator-set updates mid-sync). Discarded
+work is re-dispatched against current reality, so the DECISIONS can never
+drift from serial — only wasted device cycles are at stake.
+
+Fault sites are preserved inside the pipeline: each speculative dispatch
+still passes through `faults.fire("ops.ed25519.device")` (and the sr25519
+twin) inside ops dispatch_batch, behind the circuit breaker
+(ops/breaker.py) — an injected or real device failure degrades that
+dispatch to the host path within the same call and the pipeline's
+decisions are unchanged.
+
+`TM_TPU_VERIFY_AHEAD` sets the depth (default 4; 1 = serial behavior,
+one decision dispatched and resolved at a time). See docs/PIPELINE.md.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from dataclasses import dataclass
+
+from tendermint_tpu.crypto import batch as crypto_batch
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.validator_set import PendingCommitVerify
+
+DEFAULT_DEPTH = 4
+
+
+def verify_ahead_depth() -> int:
+    """How many blocks' commit verifications may be in flight while earlier
+    blocks save/apply. TM_TPU_VERIFY_AHEAD overrides; read per call so tests
+    and operators can flip it without restarting the sync."""
+    v = os.environ.get("TM_TPU_VERIFY_AHEAD")
+    if not v:
+        return DEFAULT_DEPTH
+    try:
+        return max(1, int(v))
+    except ValueError:
+        return DEFAULT_DEPTH
+
+
+@dataclass
+class _Entry:
+    """One speculative decision: block `first` at `height`, verified by
+    `second`'s LastCommit, dispatched against the validator set whose hash
+    was `vals_hash`."""
+
+    height: int
+    first: object
+    second: object
+    first_parts: object
+    first_id: BlockID
+    pending: PendingCommitVerify
+    vals_hash: bytes
+
+
+class VerifyAheadPipeline:
+    """Bounded depth-K speculative commit-verify queue over a BlockPool.
+
+    The reactor surface it drives (shared by v0 and v1): `.pool`, `.state`
+    (read AND reassigned after apply), `.block_store`, `.block_exec`, and
+    `._punish_invalid(height, exc)` implementing the reactor's existing
+    invalid-block path (redo h and h+1, punish both senders)."""
+
+    def __init__(self) -> None:
+        self._entries: deque[_Entry] = deque()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def discard(self) -> None:
+        """Drop all speculative in-flight work (failed resolve, stale
+        inputs). Already-issued device work is simply never fetched."""
+        self._entries.clear()
+
+    # --- dispatch ----------------------------------------------------------
+
+    def _force_device(self, reactor) -> bool:
+        """Pin speculative dispatches to the device kernel when pipelining
+        on a real accelerator. The calibrated host crossover
+        (ops/ed25519_batch.host_crossover) prices a FULL sync floor into
+        every flush — right for one synchronous decision, wrong here: the
+        pipeline's whole point is hiding that floor behind K decisions of
+        host work (copy_to_host_async starts the D2H at dispatch), after
+        which the kernel's marginal us/sig beats the host C verifier for
+        any kernel-sized batch. On a CPU backend the "device" is this same
+        host — no tunnel to hide, kernel never pays off — and small
+        commits (tests, dev nets) stay on the adaptive host/scalar path."""
+        depth = verify_ahead_depth()
+        if depth <= 1 or os.environ.get("TM_TPU_DISABLE_BATCH") == "1":
+            return False
+        try:
+            import jax
+
+            from tendermint_tpu.ops import ed25519_batch
+        except Exception:  # noqa: BLE001 - no jax, no kernels to pin
+            return False
+        if jax.default_backend() == "cpu":
+            return False
+        est_per = (2 * reactor.state.validators.size()) // 3 + 1
+        return est_per >= ed25519_batch.MIN_BUCKET
+
+    def _dispatch_entry(self, reactor, height: int) -> _Entry | None:
+        pool = reactor.pool
+        first = pool.peek_block(height)
+        second = pool.peek_block(height + 1)
+        if first is None or second is None:
+            return None
+        state = reactor.state
+        first_parts = PartSet.from_data(first.marshal())
+        first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header())
+        try:
+            # same pre-checks, in the same order, as the serial loop
+            if second.last_commit is None:
+                raise ValueError("second block has no LastCommit")
+            if second.last_commit.block_id != first_id:
+                raise ValueError("second block's LastCommit is for a different block")
+            pending = state.validators.verify_commit_light_async(
+                state.chain_id, first_id, first.header.height,
+                second.last_commit, force_device=self._force_device(reactor))
+        except Exception as e:  # noqa: BLE001 - decided at resolve time, in order
+            pending = PendingCommitVerify(error=e)
+        return _Entry(height=height, first=first, second=second,
+                      first_parts=first_parts, first_id=first_id,
+                      pending=pending, vals_hash=state.validators.hash())
+
+    def _fill(self, reactor) -> None:
+        depth = verify_ahead_depth()
+        pool = reactor.pool
+        want = pool.height + len(self._entries)
+        while len(self._entries) < depth:
+            e = self._dispatch_entry(reactor, want)
+            if e is None:
+                return
+            self._entries.append(e)
+            want += 1
+
+    # --- the one step both reactors call -----------------------------------
+
+    def process_next(self, reactor) -> bool:
+        """Verify + apply the next contiguous block through the pipeline.
+        Returns True when a block was applied (call again to drain), False
+        when the next block isn't ready or its commit was invalid (peers
+        already punished, exactly as the serial path)."""
+        pool = reactor.pool
+        for _ in range(2):
+            self._fill(reactor)
+            if not self._entries:
+                return False
+            head = self._entries[0]
+            # Re-validate dispatch-time inputs against current reality; the
+            # serial loop peeks at process time, so stale speculation must
+            # be re-dispatched, never resolved.
+            first, second = pool.peek_two_blocks()
+            if (head.height != pool.height
+                    or first is not head.first or second is not head.second
+                    or head.vals_hash != reactor.state.validators.hash()):
+                self.discard()
+                continue
+            break
+        else:
+            return False
+
+        # Batch the readbacks of every in-flight decision into ONE
+        # device_get: K floors -> 1. Entries already resolved (or
+        # host-resolved) are untouched; later resolves are then instant.
+        head = self._entries.popleft()
+        try:
+            if head.pending.pending is not None and head.pending.pending.has_device_output():
+                crypto_batch.prefetch(
+                    [e.pending.pending for e in [head, *self._entries]
+                     if e.pending.pending is not None])
+            head.pending.resolve()
+        except Exception as e:  # noqa: BLE001 - the serial invalid-block path
+            self.discard()
+            reactor._punish_invalid(head.height, e)
+            return False
+        pool.pop_request()
+        reactor.block_store.save_block(head.first, head.first_parts,
+                                       head.second.last_commit)
+        reactor.state, _ = reactor.block_exec.apply_block(
+            reactor.state, head.first_id, head.first)
+        return True

@@ -4,7 +4,9 @@ proto/tendermint/blockchain/types.proto).
 
 The hot loop verifies each fetched block with the NEXT block's LastCommit
 via VerifyCommitLight (reference: reactor.go:366) - on TPU one batched
-kernel call per block (and batchable across blocks).
+kernel call per block, pipelined ACROSS blocks by the depth-K verify-ahead
+queue (blockchain/pipeline.py, TM_TPU_VERIFY_AHEAD) so the device sync
+floor amortizes over K decisions instead of gating each one.
 
 Messages: BlockRequest=1{height}, NoBlockResponse=2{height},
 BlockResponse=3{block}, StatusRequest=4{}, StatusResponse=5{height, base}.
@@ -15,12 +17,11 @@ from __future__ import annotations
 import threading
 import time
 
+from tendermint_tpu.blockchain.pipeline import VerifyAheadPipeline
 from tendermint_tpu.encoding import proto
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.switch import Peer, Reactor
 from tendermint_tpu.types.block import Block
-from tendermint_tpu.types.block_id import BlockID
-from tendermint_tpu.types.part_set import PartSet
 
 BLOCKCHAIN_CHANNEL = 0x40
 TRY_SYNC_INTERVAL_S = 0.01
@@ -98,6 +99,12 @@ class BlockPool:
             second = self.blocks.get(self.height + 1, (None, None))[0]
             return first, second
 
+    def peek_block(self, height: int) -> Block | None:
+        """Peek any pooled height without popping (the verify-ahead
+        pipeline speculates past self.height)."""
+        with self._mtx:
+            return self.blocks.get(height, (None, None))[0]
+
     def pop_request(self) -> None:
         with self._mtx:
             self.blocks.pop(self.height, None)
@@ -142,6 +149,7 @@ class BlockchainReactor(Reactor):
         self.consensus_reactor = consensus_reactor
         self.logger = logger
         self.pool = BlockPool(block_store.height + 1)
+        self._pipeline = VerifyAheadPipeline()
         self._running = False
         self._thread: threading.Thread | None = None
         self._synced = threading.Event()
@@ -247,36 +255,29 @@ class BlockchainReactor(Reactor):
                     if self.consensus_reactor is not None:
                         self.consensus_reactor.switch_to_consensus(self.state)
                     return
-            self._try_sync()
+            # Drain: process every contiguously-available block before
+            # sleeping. The old one-block-per-tick pacing capped sync at
+            # 1/TRY_SYNC_INTERVAL_S blocks/s however fast verification ran.
+            while self._running and self._try_sync():
+                pass
             time.sleep(TRY_SYNC_INTERVAL_S)
 
-    def _try_sync(self) -> None:
-        first, second = self.pool.peek_two_blocks()
-        if first is None or second is None:
-            return
-        first_parts = PartSet.from_data(first.marshal())
-        first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header())
-        try:
-            # verify first block using second's LastCommit (reference:
-            # reactor.go:366 VerifyCommitLight -> ONE batched kernel call)
-            if second.last_commit is None:
-                raise ValueError("second block has no LastCommit")
-            if second.last_commit.block_id != first_id:
-                raise ValueError("second block's LastCommit is for a different block")
-            self.state.validators.verify_commit_light(
-                self.state.chain_id, first_id, first.header.height, second.last_commit
-            )
-        except Exception as e:  # noqa: BLE001
-            # Punish BOTH senders: the bad LastCommit is carried by the
-            # second block (reference: blockchain/v0/reactor.go:394-408).
-            bad = self.pool.redo_request(first.header.height)
-            bad2 = self.pool.redo_request(first.header.height + 1)
-            if self.switch is not None:
-                for pid in {bad, bad2} - {None}:
-                    if pid in self.switch.peers:
-                        self.switch.stop_peer_for_error(
-                            self.switch.peers[pid], f"invalid block: {e}")
-            return
-        self.pool.pop_request()
-        self.block_store.save_block(first, first_parts, second.last_commit)
-        self.state, _ = self.block_exec.apply_block(self.state, first_id, first)
+    def _try_sync(self) -> bool:
+        """Verify + apply the next block through the depth-K verify-ahead
+        pipeline (blockchain/pipeline.py): commit verification for blocks
+        h..h+K-1 is dispatched while block h saves/applies, readbacks are
+        batched, decisions resolve in height order with serial semantics
+        (reference: reactor.go:366 VerifyCommitLight). True when a block
+        was applied."""
+        return self._pipeline.process_next(self)
+
+    def _punish_invalid(self, height: int, e: Exception) -> None:
+        """Punish BOTH senders: the bad LastCommit is carried by the
+        second block (reference: blockchain/v0/reactor.go:394-408)."""
+        bad = self.pool.redo_request(height)
+        bad2 = self.pool.redo_request(height + 1)
+        if self.switch is not None:
+            for pid in {bad, bad2} - {None}:
+                if pid in self.switch.peers:
+                    self.switch.stop_peer_for_error(
+                        self.switch.peers[pid], f"invalid block: {e}")

@@ -1,0 +1,87 @@
+"""Headless fast-sync replay: drive the verify-ahead pipeline
+(blockchain/pipeline.py) over pre-built blocks with stub persistence — no
+p2p, no disk. The ONE copy of the chained-block builder (real part-set
+block IDs in every LastCommit, what the fast-sync verify checks) and the
+minimal reactor surface VerifyAheadPipeline drives, shared by the bench
+correctness gate (bench.py config_fastsync) and the pipeline tests
+(tests/test_fastsync_pipeline.py, tests/test_perf_gate.py) so the two can
+never drift."""
+
+from __future__ import annotations
+
+import hashlib
+import types as pytypes
+
+from tendermint_tpu.blockchain.reactor import BlockPool
+from tendermint_tpu.types.block import Block, Commit, CommitSig, Data, Header
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.vote import BLOCK_ID_FLAG_COMMIT, PRECOMMIT_TYPE, Vote
+
+
+def signed_commit(chain_id, vals, privs, height, bid, ts, round_=1):
+    """One precommit per validator over the canonical sign bytes."""
+    sigs = []
+    for i, (p, v) in enumerate(zip(privs, vals.validators)):
+        vote = Vote(type=PRECOMMIT_TYPE, height=height, round=round_,
+                    block_id=bid, timestamp=ts, validator_address=v.address,
+                    validator_index=i)
+        sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, v.address, ts,
+                              p.sign(vote.sign_bytes(chain_id))))
+    return Commit(height=height, round=round_, block_id=bid, signatures=sigs)
+
+
+def make_chain(chain_id, n, vals, privs):
+    """n chained blocks with real part-set block IDs in each LastCommit —
+    what the fast-sync verify checks."""
+    blocks, prev_commit, prev_bid = [], None, BlockID()
+    for h in range(1, n + 1):
+        header = Header(chain_id=chain_id, height=h,
+                        time=Time(1_700_000_000 + h, 0),
+                        last_block_id=prev_bid, validators_hash=vals.hash(),
+                        next_validators_hash=vals.hash(),
+                        proposer_address=vals.validators[0].address)
+        block = Block(header=header, data=Data(), last_commit=prev_commit)
+        bhash = block.hash()
+        parts = PartSet.from_data(block.marshal())
+        bid = BlockID(hash=bhash, part_set_header=parts.header())
+        prev_commit = signed_commit(chain_id, vals, privs, h, bid,
+                                    Time(header.time.seconds, 0))
+        prev_bid = bid
+        blocks.append(block)
+    return blocks
+
+
+class ReplayCtx:
+    """Minimal reactor surface for VerifyAheadPipeline: a real BlockPool,
+    stub store/executor, app hash chained over accepted block IDs (two
+    replays accepting the same blocks in the same order agree)."""
+
+    def __init__(self, vals, chain_id):
+        self.pool = BlockPool(1)
+        self.state = pytypes.SimpleNamespace(validators=vals,
+                                             chain_id=chain_id)
+        self.applied: list[int] = []
+        self.punished: list[str] = []
+        self.app_hash = b"\x00" * 32
+        outer = self
+
+        class _Store:
+            def save_block(self, block, parts, seen_commit):
+                pass
+
+        class _Exec:
+            def apply_block(self, state, block_id, block):
+                outer.applied.append(block.header.height)
+                outer.app_hash = hashlib.sha256(
+                    outer.app_hash + block_id.hash).digest()
+                return state, 0
+
+        self.block_store = _Store()
+        self.block_exec = _Exec()
+
+    def _punish_invalid(self, height, e):
+        bad = self.pool.redo_request(height)
+        bad2 = self.pool.redo_request(height + 1)
+        self.punished.extend(sorted({bad, bad2} - {None}))

@@ -19,6 +19,7 @@ import threading
 import time
 from dataclasses import dataclass
 
+from tendermint_tpu.blockchain.pipeline import VerifyAheadPipeline
 from tendermint_tpu.blockchain.reactor import (
     BLOCKCHAIN_CHANNEL,
     BlockPool,
@@ -32,8 +33,6 @@ from tendermint_tpu.encoding import proto
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.switch import Peer, Reactor
 from tendermint_tpu.types.block import Block
-from tendermint_tpu.types.block_id import BlockID
-from tendermint_tpu.types.part_set import PartSet
 
 # states (reference: reactor_fsm.go:22-28)
 S_UNKNOWN = "unknown"
@@ -131,6 +130,7 @@ class BlockchainReactorV1(Reactor):
         self.consensus_reactor = consensus_reactor
         self.logger = logger
         self.pool = BlockPool(block_store.height + 1)
+        self._pipeline = VerifyAheadPipeline()
         self.fsm = FastSyncFSM(self)
         self._events: queue.Queue = queue.Queue(maxsize=1000)
         self._running = False
@@ -251,34 +251,19 @@ class BlockchainReactorV1(Reactor):
                 p.try_send(BLOCKCHAIN_CHANNEL, msg_block_request(h))
 
     def try_process_block(self) -> bool:
-        """Verify + apply the next contiguous block; False when not ready
+        """Verify + apply the next contiguous block through the depth-K
+        verify-ahead pipeline (blockchain/pipeline.py); False when not ready
         (reference: processBlock -> VerifyCommitLight at reactor.go:478)."""
-        first, second = self.pool.peek_two_blocks()
-        if first is None or second is None:
-            return False
-        first_parts = PartSet.from_data(first.marshal())
-        first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header())
-        try:
-            if second.last_commit is None:
-                raise ValueError("second block has no LastCommit")
-            if second.last_commit.block_id != first_id:
-                raise ValueError("second block's LastCommit is for a different block")
-            self.state.validators.verify_commit_light(
-                self.state.chain_id, first_id, first.header.height,
-                second.last_commit)
-        except Exception as e:  # noqa: BLE001
-            # The invalid LastCommit rides in the SECOND block: punish both
-            # senders (reference: blockchain/v1/reactor.go processBlock
-            # failure path redoes first.Height and first.Height+1).
-            bad = self.pool.redo_request(first.header.height)
-            bad2 = self.pool.redo_request(first.header.height + 1)
-            for pid in {bad, bad2} - {None}:
-                self.drop_peer(pid, f"invalid block: {e}")
-            return False
-        self.pool.pop_request()
-        self.block_store.save_block(first, first_parts, second.last_commit)
-        self.state, _ = self.block_exec.apply_block(self.state, first_id, first)
-        return True
+        return self._pipeline.process_next(self)
+
+    def _punish_invalid(self, height: int, e: Exception) -> None:
+        """The invalid LastCommit rides in the SECOND block: punish both
+        senders (reference: blockchain/v1/reactor.go processBlock failure
+        path redoes first.Height and first.Height+1)."""
+        bad = self.pool.redo_request(height)
+        bad2 = self.pool.redo_request(height + 1)
+        for pid in {bad, bad2} - {None}:
+            self.drop_peer(pid, f"invalid block: {e}")
 
     def on_finished(self) -> None:
         self._running = False

@@ -185,7 +185,7 @@ class ConsensusState:
         self._timeout_queue: queue.Queue = queue.Queue()
         self._mtx = threading.RLock()
         self._holdover: object | None = None  # non-vote msg dequeued mid-drain
-        # In-flight batched vote flush: (msgs, queued, devs, resolve).  The
+        # In-flight batched vote flush: (msgs, queued, PendingVerify).  The
         # drain dispatches a batch and keeps consuming the queue while the
         # device verifies; the result is applied before ANY other state
         # transition (next batch, timeout, non-vote message) so side-effect
@@ -446,16 +446,13 @@ class ConsensusState:
             if not queued:
                 self._apply_vote_results(msgs, {})
                 return
-            devs, resolve = verifier.dispatch()
-            has_device = any(
-                d is not None
-                for d in (devs if isinstance(devs, list) else [devs]))
-            if has_device:
+            pending = verifier.dispatch()
+            if pending.has_device_output():
                 # stash; the drain loop applies it before the next state
                 # transition, overlapping the round trip with more draining
-                self._pending_flush = (msgs, queued, devs, resolve)
+                self._pending_flush = (msgs, queued, pending)
                 return
-            _, bitmap = resolve(devs if isinstance(devs, list) else None)
+            _, bitmap = pending.resolve()
             ok_by_i = dict(zip(queued, bitmap))
         except Exception as e:  # noqa: BLE001
             # A flush failure (device OOM, runtime hiccup) must not kill the
@@ -473,12 +470,10 @@ class ConsensusState:
         if pf is None:
             return
         self._pending_flush = None
-        msgs, queued, devs, resolve = pf
+        msgs, queued, pending = pf
         ok_by_i: dict[int, bool] = {}
         try:
-            import jax
-
-            _, bitmap = resolve(jax.device_get(devs))
+            _, bitmap = pending.resolve()
             ok_by_i = dict(zip(queued, bitmap))
         except Exception as e:  # noqa: BLE001 - same fallback as the sync path
             ok_by_i = {}

@@ -83,7 +83,7 @@ def verify_header_range(trusted: LightBlock, chain: list[LightBlock],
     chunk_sigs_target = (-(-est_total // k)) if k > 1 else est_total + 1
     verifier = crypto_batch.create_batch_verifier()
     plan = []  # (lb, prefix, needed)
-    pending = []  # (plan_chunk, devs, resolve)
+    pending = []  # (plan_chunk, PendingVerify)
     for lb in chain:
         sh, vals = lb.signed_header, lb.validator_set
         commit = sh.commit
@@ -103,11 +103,11 @@ def verify_header_range(trusted: LightBlock, chain: list[LightBlock],
                 signatures[idx].signature)
         plan.append((lb, prefix, needed))
         if len(verifier) >= chunk_sigs_target:
-            pending.append((plan,) + verifier.dispatch(force_device=use_device))
+            pending.append((plan, verifier.dispatch(force_device=use_device)))
             verifier = crypto_batch.create_batch_verifier()
             plan = []
     if plan:
-        pending.append((plan,) + verifier.dispatch(force_device=use_device))
+        pending.append((plan, verifier.dispatch(force_device=use_device)))
 
     # Phase 2 (STRUCTURE, overlapping the signature flights): the serial
     # chain-linkage walk.  Same accept/reject set as the sequential loop;
@@ -138,15 +138,14 @@ def verify_header_range(trusted: LightBlock, chain: list[LightBlock],
             )
         prev = lb
 
-    # Phase 3: ONE readback for every chunk's flush (device_get on the
-    # nested dev list; most results have already landed).
-    import jax
-
-    fetched = jax.device_get([devs for (_, devs, _) in pending])
+    # Phase 3: ONE readback for every chunk's flush (crypto_batch.prefetch
+    # batches every pending's device outputs into one device_get; most
+    # results have already landed).
+    crypto_batch.prefetch([pv for (_, pv) in pending])
 
     # Phase 4: replay each header's serial decision over its bitmap slice.
-    for (plan_chunk, _devs, resolve), f in zip(pending, fetched):
-        _, bitmap = resolve(f)
+    for plan_chunk, pv in pending:
+        _, bitmap = pv.resolve()
         pos = 0
         for lb, prefix, needed in plan_chunk:
             vals, commit = lb.validator_set, lb.signed_header.commit

@@ -61,6 +61,46 @@ class ErrWrongSignature(ValidatorSetError):
         self.index = idx
 
 
+class PendingCommitVerify:
+    """A dispatched-but-undecided commit verification (the cross-decision
+    pipeline handle of verify_commit_async / verify_commit_light_async).
+
+    All host prep and device dispatch happened at creation; ``resolve()``
+    performs the (possibly batched-away) readback and replays the EXACT
+    serial accept/reject decision procedure, raising precisely what the
+    synchronous call would have raised — structural errors captured at
+    dispatch time included, so error ordering per decision is unchanged.
+    Decision inputs (stopping prefix, voting powers, threshold) are frozen
+    at dispatch: a caller that mutates the ValidatorSet afterwards gets the
+    dispatch-time decision, the only sane semantics for speculative
+    verification (the fast-sync pipeline discards handles whose validator
+    set changed before their turn).
+
+    ``pending`` exposes the underlying crypto-layer
+    :class:`~tendermint_tpu.crypto.batch.PendingVerify` (None when the
+    decision needed no device work) so callers with several decisions in
+    flight can batch the readbacks into one device_get
+    (crypto_batch.prefetch)."""
+
+    __slots__ = ("pending", "_finalize", "_error")
+
+    def __init__(self, pending=None, finalize=None, error: Exception | None = None):
+        self.pending = pending
+        self._finalize = finalize
+        self._error = error
+
+    def resolve(self) -> None:
+        """Raises exactly what the synchronous verify would; returns None on
+        accept. Idempotent: the bitmap is cached by the crypto layer and the
+        decision replay is deterministic."""
+        if self._error is not None:
+            raise self._error
+        bitmap: list[bool] = []
+        if self.pending is not None:
+            _, bitmap = self.pending.resolve()
+        self._finalize(bitmap)
+
+
 class ValidatorSet:
     """Sorted by voting power desc, then address asc. Not thread-safe."""
 
@@ -297,17 +337,31 @@ class ValidatorSet:
 
     # --- commit verification (the TPU hot path) ----------------------------
 
+    def _commit_structural_error(self, block_id: BlockID, height: int,
+                                 commit) -> ValidatorSetError | None:
+        """The shared pre-signature checks of every Verify* entry point."""
+        if self.size() != len(commit.signatures):
+            return ErrInvalidCommitSignatures(self.size(), len(commit.signatures))
+        if height != commit.height:
+            return ErrInvalidCommitHeight(height, commit.height)
+        if block_id != commit.block_id:
+            return ValidatorSetError(
+                f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
+            )
+        return None
+
     def verify_commit(self, chain_id: str, block_id: BlockID, height: int, commit) -> None:
         """Checks ALL signatures; first bad index wins (reference:
         types/validator_set.go:660-715)."""
-        if self.size() != len(commit.signatures):
-            raise ErrInvalidCommitSignatures(self.size(), len(commit.signatures))
-        if height != commit.height:
-            raise ErrInvalidCommitHeight(height, commit.height)
-        if block_id != commit.block_id:
-            raise ValidatorSetError(
-                f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
-            )
+        self.verify_commit_async(chain_id, block_id, height, commit).resolve()
+
+    def verify_commit_async(self, chain_id: str, block_id: BlockID, height: int,
+                            commit, force_device: bool = False) -> PendingCommitVerify:
+        """Deferred verify_commit: host prep + device dispatch now, the
+        serial decision replay (identical errors) on resolve()."""
+        err = self._commit_structural_error(block_id, height, commit)
+        if err is not None:
+            return PendingCommitVerify(error=err)
         verifier = crypto_batch.create_batch_verifier()
         queued: list[int] = []
         for idx, cs in enumerate(commit.signatures):
@@ -319,20 +373,25 @@ class ValidatorSet:
                 cs.signature,
             )
             queued.append(idx)
-        _, bitmap = verifier.verify()
-        ok_by_idx = dict(zip(queued, bitmap))
-
-        tallied = 0
+        pending = verifier.dispatch(force_device=force_device)
+        # Freeze the decision inputs at dispatch time.
         needed = self.total_voting_power() * 2 // 3
-        for idx, cs in enumerate(commit.signatures):
-            if cs.absent():
-                continue
-            if not ok_by_idx[idx]:
-                raise ErrWrongSignature(idx, cs.signature)
-            if cs.for_block():
-                tallied += self.validators[idx].voting_power
-        if tallied <= needed:
-            raise ErrNotEnoughVotingPowerSigned(tallied, needed)
+        powers = [self.validators[idx].voting_power for idx in queued]
+        signatures = list(commit.signatures)
+
+        def finalize(bitmap: list[bool]) -> None:
+            ok_by_idx = dict(zip(queued, bitmap))
+            tallied = 0
+            for idx, power in zip(queued, powers):
+                cs = signatures[idx]
+                if not ok_by_idx[idx]:
+                    raise ErrWrongSignature(idx, cs.signature)
+                if cs.for_block():
+                    tallied += power
+            if tallied <= needed:
+                raise ErrNotEnoughVotingPowerSigned(tallied, needed)
+
+        return PendingCommitVerify(pending, finalize)
 
     def commit_light_prefix(self, commit, needed: int) -> list[int]:
         """Indexes the serial VerifyCommitLight would actually verify: the
@@ -355,14 +414,18 @@ class ValidatorSet:
         """Stops at +2/3 like the serial code: signatures past the serial
         stopping point are not consulted (reference:
         types/validator_set.go:719-766)."""
-        if self.size() != len(commit.signatures):
-            raise ErrInvalidCommitSignatures(self.size(), len(commit.signatures))
-        if height != commit.height:
-            raise ErrInvalidCommitHeight(height, commit.height)
-        if block_id != commit.block_id:
-            raise ValidatorSetError(
-                f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
-            )
+        self.verify_commit_light_async(chain_id, block_id, height, commit).resolve()
+
+    def verify_commit_light_async(self, chain_id: str, block_id: BlockID,
+                                  height: int, commit,
+                                  force_device: bool = False) -> PendingCommitVerify:
+        """Deferred verify_commit_light: the fast-sync verify-ahead pipeline
+        (blockchain/pipeline.py) dispatches several heights' commits through
+        this, overlapping the device round trips with block save/apply, and
+        replays each height's serial decision in order on resolve()."""
+        err = self._commit_structural_error(block_id, height, commit)
+        if err is not None:
+            return PendingCommitVerify(error=err)
         needed = self.total_voting_power() * 2 // 3
         prefix = self.commit_light_prefix(commit, needed)
         verifier = crypto_batch.create_batch_verifier()
@@ -372,16 +435,21 @@ class ValidatorSet:
                 commit.vote_sign_bytes(chain_id, idx),
                 commit.signatures[idx].signature,
             )
-        _, bitmap = verifier.verify()
+        pending = verifier.dispatch(force_device=force_device)
+        powers = [self.validators[idx].voting_power for idx in prefix]
+        signatures = list(commit.signatures)
 
-        tallied = 0
-        for idx, ok in zip(prefix, bitmap):
-            if not ok:
-                raise ErrWrongSignature(idx, commit.signatures[idx].signature)
-            tallied += self.validators[idx].voting_power
-            if tallied > needed:
-                return
-        raise ErrNotEnoughVotingPowerSigned(tallied, needed)
+        def finalize(bitmap: list[bool]) -> None:
+            tallied = 0
+            for idx, power, ok in zip(prefix, powers, bitmap):
+                if not ok:
+                    raise ErrWrongSignature(idx, signatures[idx].signature)
+                tallied += power
+                if tallied > needed:
+                    return
+            raise ErrNotEnoughVotingPowerSigned(tallied, needed)
+
+        return PendingCommitVerify(pending, finalize)
 
     def verify_commit_light_trusting(self, chain_id: str, commit, trust_level) -> None:
         """trust_level of THIS set must have signed (reference:

@@ -73,6 +73,11 @@ def _pin_kernel_path(request, monkeypatch):
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
+        # An explicit @pytest.mark.quick/slow on the test wins over the
+        # module default (a no-kernel gate in a kernel-heavy module can
+        # opt into the quick tier).
+        if item.get_closest_marker("quick") or item.get_closest_marker("slow"):
+            continue
         mod = item.module.__name__.rsplit(".", 1)[-1]
         item.add_marker(pytest.mark.slow if mod in _SLOW_MODULES
                         else pytest.mark.quick)

@@ -1,0 +1,260 @@
+"""The fast-sync verify-ahead pipeline (blockchain/pipeline.py): in-order
+resolve, speculative-work discard, two-peer punishment, and convergence to
+the depth-1 app hash — with and without device-failure injection inside the
+pipeline (the ISSUE 2 acceptance matrix)."""
+
+import types as pytypes
+
+import pytest
+
+from tendermint_tpu.blockchain.replay import ReplayCtx, make_chain
+from tendermint_tpu.blockchain import pipeline as bpipe
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.types.block import Block, Commit, CommitSig
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import BLOCK_ID_FLAG_COMMIT, PRECOMMIT_TYPE, Vote
+
+CHAIN_ID = "pipe-chain"
+N_BLOCKS = 10  # pool holds 10 blocks -> 9 appliable heights
+
+
+def _mk_vals(n):
+    privs = [ed25519.gen_priv_key((i + 1).to_bytes(2, "big") * 16)
+             for i in range(n)]
+    vals = ValidatorSet([Validator.new(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    return [by_addr[v.address] for v in vals.validators], vals
+
+
+
+
+def _tampered_copy(block):
+    """Deep copy with the first LastCommit signature corrupted (inside the
+    +2/3 serial stopping prefix, so resolve raises ErrWrongSignature)."""
+    bad = Block.unmarshal(block.marshal())
+    sig = bytearray(bad.last_commit.signatures[0].signature)
+    sig[0] ^= 0xFF
+    bad.last_commit.signatures[0].signature = bytes(sig)
+    return bad
+
+
+@pytest.fixture()
+def chain():
+    privs, vals = _mk_vals(4)
+    return vals, make_chain(CHAIN_ID, N_BLOCKS, vals, privs)
+
+
+def _reference_run(vals, blocks, monkeypatch):
+    """Depth-1 (serial-behavior) run over a pristine pool: the convergence
+    oracle every pipeline scenario must match."""
+    monkeypatch.setenv("TM_TPU_VERIFY_AHEAD", "1")
+    ctx = ReplayCtx(vals, CHAIN_ID)
+    for b in blocks:
+        ctx.pool.add_block("good", b)
+    pipe = bpipe.VerifyAheadPipeline()
+    while pipe.process_next(ctx):
+        pass
+    assert ctx.applied == list(range(1, N_BLOCKS)) and not ctx.punished
+    return ctx
+
+
+def _bad_commit_scenario(vals, blocks, monkeypatch):
+    """Depth-4 pipeline over a pool where block 5 (sent by bad2) carries a
+    corrupted LastCommit for block 4 (sent by bad1): heights 1..3 resolve
+    in order, height 4's resolve fails mid-pipeline."""
+    monkeypatch.setenv("TM_TPU_VERIFY_AHEAD", "4")
+    ctx = ReplayCtx(vals, CHAIN_ID)
+    for b in blocks:
+        h = b.header.height
+        peer = {4: "bad1", 5: "bad2"}.get(h, "good")
+        ctx.pool.add_block(peer, _tampered_copy(b) if h == 5 else b)
+    pipe = bpipe.VerifyAheadPipeline()
+    while pipe.process_next(ctx):
+        pass
+    # In-order resolve up to the failure; all speculation discarded.
+    assert ctx.applied == [1, 2, 3]
+    assert len(pipe) == 0
+    # BOTH senders punished (the bad LastCommit rides in the SECOND block),
+    # and their blocks were dropped for re-request — exactly the serial path.
+    assert ctx.punished == ["bad1", "bad2"]
+    assert ctx.pool.peek_block(4) is None and ctx.pool.peek_block(5) is None
+    assert ctx.pool.height == 4
+    # "Re-requested" blocks arrive clean from a good peer: the pipeline
+    # converges.
+    ctx.pool.add_block("good", blocks[3])
+    ctx.pool.add_block("good", blocks[4])
+    while pipe.process_next(ctx):
+        pass
+    assert ctx.applied == list(range(1, N_BLOCKS))
+    return ctx
+
+
+def test_mid_pipeline_bad_commit_matches_serial(chain, monkeypatch):
+    vals, blocks = chain
+    ref = _reference_run(vals, blocks, monkeypatch)
+    ctx = _bad_commit_scenario(vals, blocks, monkeypatch)
+    assert ctx.app_hash == ref.app_hash
+
+
+def test_mid_pipeline_bad_commit_with_device_fault(chain, monkeypatch):
+    """TMTPU_FAULTS device failure INSIDE the pipeline: the injected raise
+    at the speculative dispatch degrades through the circuit breaker to the
+    host path within the same call — decisions, punishments, and the final
+    app hash are byte-identical to the fault-free pipeline and the serial
+    path."""
+    from tendermint_tpu.ops import ed25519_batch
+    from tendermint_tpu.utils import faults
+
+    ref = _reference_run(*chain, monkeypatch)
+    vals, blocks = chain
+    # Route flushes at the device (crossover 0 pins the device path, the
+    # verify-ahead force_device heuristic then applies) and make the FIRST
+    # speculative dispatch die; the breaker keeps later flushes on host.
+    # A huge cooldown keeps the background re-probe from touching the
+    # device (and compiling kernels) during the test.
+    monkeypatch.setenv("TM_TPU_HOST_CROSSOVER", "0")
+    monkeypatch.setenv("TM_TPU_BREAKER_COOLDOWN_S", "3600")
+    faults.configure(["ops.ed25519.device:raise@1"], seed=7)
+    try:
+        ctx = _bad_commit_scenario(vals, blocks, monkeypatch)
+    finally:
+        faults.clear()
+        ed25519_batch.BREAKER.reset()
+    assert ed25519_batch.BREAKER.failures >= 1  # the fault really fired
+    assert ctx.app_hash == ref.app_hash
+
+
+def test_depth_env_clamped(monkeypatch):
+    monkeypatch.setenv("TM_TPU_VERIFY_AHEAD", "0")
+    assert bpipe.verify_ahead_depth() == 1
+    monkeypatch.setenv("TM_TPU_VERIFY_AHEAD", "junk")
+    assert bpipe.verify_ahead_depth() == bpipe.DEFAULT_DEPTH
+    monkeypatch.delenv("TM_TPU_VERIFY_AHEAD")
+    assert bpipe.verify_ahead_depth() == bpipe.DEFAULT_DEPTH
+
+
+def test_real_reactor_end_to_end_depths_agree(monkeypatch):
+    """The REAL v0 reactor glue (no sockets): a chain built by a source
+    BlockExecutor is replayed through BlockchainReactor._try_sync with a
+    real executor + stores, at depth 1 and depth 4. Both must apply every
+    block and land on the source's app hash."""
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.blockchain.reactor import BlockchainReactor
+    from tendermint_tpu.mempool.mempool import Mempool
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.state.state import make_genesis_state
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.block_store import BlockStore
+    from tendermint_tpu.store.db import MemDB
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    privs = [ed25519.gen_priv_key(bytes([80 + i]) * 32) for i in range(2)]
+    gd = GenesisDoc(chain_id="pipe-e2e", genesis_time=Time(1700000000, 0),
+                    validators=[GenesisValidator(b"", p.pub_key(), 10)
+                                for p in privs])
+    gd.validate_and_complete()
+    by_addr = {p.pub_key().address(): p for p in privs}
+
+    def commit_for(state, block):
+        bid = BlockID(hash=block.hash(),
+                      part_set_header=PartSet.from_data(block.marshal()).header())
+        sigs = []
+        for i, val in enumerate(state.validators.validators):
+            v = Vote(type=PRECOMMIT_TYPE, height=block.header.height, round=0,
+                     block_id=bid, timestamp=block.header.time.add_ns(1_000_000),
+                     validator_address=val.address, validator_index=i)
+            sig = by_addr[val.address].sign(v.sign_bytes(state.chain_id))
+            sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, val.address,
+                                  v.timestamp, sig))
+        return bid, Commit(height=block.header.height, round=0, block_id=bid,
+                           signatures=sigs)
+
+    # Source chain: 8 blocks through a real executor.
+    state = make_genesis_state(gd)
+    app = KVStoreApplication()
+    ss = StateStore(MemDB())
+    ss.save(state)
+    bx = BlockExecutor(ss, app, mempool=Mempool(app))
+    last_commit = Commit(height=0, round=0, block_id=BlockID(), signatures=[])
+    blocks = []
+    block_time = Time(1700000010, 0)
+    for h in range(1, 9):
+        block = bx.create_proposal_block(
+            h, state, last_commit, state.validators.get_proposer().address,
+            block_time=block_time)
+        bid, commit = commit_for(state, block)
+        state, _ = bx.apply_block(state, bid, block)
+        last_commit = commit
+        # validation pins h+1's time to the weighted median of h's commit
+        # timestamps (block time + 1 ms, per commit_for)
+        block_time = block.header.time.add_ns(1_000_000)
+        blocks.append(block)
+
+    results = {}
+    for depth in (1, 4):
+        monkeypatch.setenv("TM_TPU_VERIFY_AHEAD", str(depth))
+        rstate = make_genesis_state(gd)
+        rapp = KVStoreApplication()
+        rss = StateStore(MemDB())
+        rss.save(rstate)
+        rbx = BlockExecutor(rss, rapp, mempool=Mempool(rapp))
+        rbs = BlockStore(MemDB())
+        reactor = BlockchainReactor(rstate, rbx, rbs, fast_sync=True)
+        for b in blocks:
+            reactor.pool.add_block("p", b)
+        applied = 0
+        while reactor._try_sync():
+            applied += 1
+        # 8 pooled blocks -> 7 appliable heights (the last needs a successor)
+        assert applied == 7 and rbs.height == 7
+        assert reactor.state.last_block_height == 7
+        results[depth] = reactor.state.app_hash
+        assert rbs.load_block(7).hash() == blocks[6].hash()
+    assert results[1] == results[4]
+
+
+def test_validator_set_change_discards_speculation(chain, monkeypatch):
+    """An apply that changes the validator-set hash must invalidate
+    speculative dispatches made against the old set: the pipeline discards
+    them, re-dispatches against the new set, and converges — decisions
+    can't drift from serial. (The power bump keeps sort order, so the old
+    commits still verify under the new set; what changes is the hash the
+    guard watches.)"""
+    vals, blocks = chain
+    ref = _reference_run(vals, blocks, monkeypatch)
+    monkeypatch.setenv("TM_TPU_VERIFY_AHEAD", "4")
+    ctx = ReplayCtx(vals, CHAIN_ID)
+    for b in blocks:
+        ctx.pool.add_block("good", b)
+    real_exec = ctx.block_exec
+
+    class _RotatingExec:
+        def apply_block(self, state, block_id, block):
+            state, rh = real_exec.apply_block(state, block_id, block)
+            if block.header.height == 2:
+                rotated = state.validators.copy()
+                rotated.update_with_change_set(
+                    [Validator.new(rotated.validators[0].pub_key, 20)])
+                state = pytypes.SimpleNamespace(validators=rotated,
+                                                chain_id=CHAIN_ID)
+            return state, rh
+
+    ctx.block_exec = _RotatingExec()
+    pipe = bpipe.VerifyAheadPipeline()
+    discards = {"n": 0}
+    orig_discard = pipe.discard
+
+    def spy_discard():
+        discards["n"] += 1
+        orig_discard()
+
+    pipe.discard = spy_discard
+    while pipe.process_next(ctx):
+        pass
+    assert discards["n"] >= 1, "stale-valset speculation was never discarded"
+    assert ctx.applied == list(range(1, N_BLOCKS)) and not ctx.punished
+    assert ctx.app_hash == ref.app_hash

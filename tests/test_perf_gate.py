@@ -100,6 +100,59 @@ def test_verify_commit_stays_batched(n_vals, monkeypatch):
     assert light_s < ceiling, f"verify_commit_light {light_s:.1f}s > {ceiling}s"
 
 
+@pytest.mark.quick
+def test_verify_ahead_batches_blocking_fetches(monkeypatch):
+    """The verify-ahead pipeline gate (no wall clock, no kernels): over the
+    same chain, a depth-4 pipeline must issue NO MORE blocking device
+    fetches than depth 1 — the whole point of verify-ahead is amortizing
+    the per-fetch sync floor across in-flight decisions. Kernel dispatch is
+    stubbed with a sentinel "device" output (the scalar result computed
+    eagerly), and the fetch-spy counts crypto_batch._device_get calls, the
+    one choke point every blocking readback passes through."""
+    from tendermint_tpu.blockchain.replay import ReplayCtx, make_chain
+    from tendermint_tpu.blockchain import pipeline as bpipe
+
+    n_blocks = 8
+    privs, vals = _mk_vals(4)
+    blocks = make_chain(CHAIN_ID, n_blocks + 1, vals, privs)
+
+    def fake_dispatch(self, force_device=False):
+        items, self._items = self._items, []
+        out = [ed25519.verify(p, m, s) for (p, m, s) in items]
+        return cbatch.PendingVerify(
+            [object()], lambda _f, _r=(all(out), out): _r)
+
+    fetches = {"n": 0}
+
+    def counting_get(tree):
+        fetches["n"] += 1
+        return tree  # sentinel "device" outputs need no real transfer
+
+    monkeypatch.setattr(cbatch._KernelBatchVerifier, "dispatch", fake_dispatch)
+    monkeypatch.setattr(cbatch, "_device_get", counting_get)
+
+    def run_depth(depth):
+        monkeypatch.setenv("TM_TPU_VERIFY_AHEAD", str(depth))
+        ctx = ReplayCtx(vals, CHAIN_ID)
+        for b in blocks:
+            ctx.pool.add_block("p", b)
+        pipe = bpipe.VerifyAheadPipeline()
+        fetches["n"] = 0
+        applied = 0
+        while pipe.process_next(ctx):
+            applied += 1
+        assert applied == n_blocks
+        return fetches["n"]
+
+    depth1 = run_depth(1)
+    depth4 = run_depth(4)
+    assert depth1 == n_blocks, f"depth-1 issued {depth1} fetches, expected one per block"
+    # strictly fewer (which also satisfies the <= acceptance bound)
+    assert depth4 < depth1, (
+        f"depth-4 pipeline did not batch readbacks: {depth4} fetches vs "
+        f"depth-1's {depth1}")
+
+
 def test_range_verify_one_flush_and_no_scalar_header_hashing(monkeypatch):
     """BASELINE config 3's shape must not silently regress: the whole range
     verifies in EXACTLY one kernel flush, and header hashing goes through
