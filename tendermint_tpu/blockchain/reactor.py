@@ -67,6 +67,19 @@ class BlockPool:
         with self._mtx:
             self.peers[peer_id] = (base, height)
 
+    def reset(self, start_height: int) -> None:
+        """Re-arm the pool for a fresh sync round (the watchdog hand-back):
+        forget peer ranges and buffered blocks. Ranges recorded before a
+        partition sit at ≈ our own stalled height, so keeping them would
+        fake an instant is_caught_up() and bounce the node straight back
+        into stalled consensus; fresh StatusResponses repopulate them
+        within one status broadcast."""
+        with self._mtx:
+            self.height = start_height
+            self.peers = {}
+            self.blocks = {}
+            self.requested = {}
+
     def remove_peer(self, peer_id: str) -> None:
         with self._mtx:
             self.peers.pop(peer_id, None)
@@ -201,12 +214,19 @@ class BlockchainReactor(Reactor):
         self._thread.start()
 
     def switch_to_fast_sync(self, state) -> None:
-        """Hand-off from state sync: resume fast sync from the bootstrapped
-        height (reference: blockchain/v0/reactor.go:109 SwitchToFastSync,
-        called from node.go:991 startStateSync)."""
+        """Re-enter fast sync from the given state. Two callers: the
+        state-sync bootstrap hand-off (reference: blockchain/v0/reactor.go
+        :109 SwitchToFastSync, node.go:991 startStateSync), and the
+        consensus stall watchdog handing a stalled node back for catchup —
+        so this must be re-entrant: stale speculation is discarded and the
+        synced latch re-arms."""
+        if self._running:
+            return
         self.state = state
         self.initial_state = state
-        self.pool.height = state.last_block_height + 1
+        self.pool.reset(state.last_block_height + 1)
+        self._pipeline.discard()
+        self._synced.clear()
         self.fast_sync = True
         self.start_sync()
 

@@ -206,10 +206,17 @@ class BlockchainReactorV1(Reactor):
         self._thread.start()
 
     def switch_to_fast_sync(self, state) -> None:
-        """Post-state-sync hand-off (same surface as v0)."""
+        """Re-enter fast sync (same surface as v0): the post-state-sync
+        hand-off and the stall watchdog's hand-back both land here, so the
+        FSM restarts from scratch with stale speculation discarded."""
+        if self._running:
+            return
         self.state = state
         self.initial_state = state
-        self.pool.height = state.last_block_height + 1
+        self.pool.reset(state.last_block_height + 1)
+        self._pipeline.discard()
+        self._synced.clear()
+        self.fsm.state = S_UNKNOWN
         self.fast_sync = True
         self.start_sync()
 

@@ -137,6 +137,12 @@ class ConsensusConfig:
     peer_gossip_sleep_duration_s: float = 0.1
     peer_query_maj23_sleep_duration_s: float = 2.0
     double_sign_check_height: int = 0
+    # Stall watchdog (consensus/watchdog.py): hand the node back to
+    # fast-sync catchup when no height commits for watchdog_stall_multiple
+    # × the expected block interval while peers report heights at least
+    # watchdog_peer_lead ahead. 0 disables the watchdog entirely.
+    watchdog_stall_multiple: float = 12.0
+    watchdog_peer_lead: int = 2
 
     # reference: config/config.go Propose/Prevote/Precommit/Commit helpers
     def propose(self, round_: int) -> float:
@@ -153,6 +159,16 @@ class ConsensusConfig:
 
     def wait_for_txs(self) -> bool:
         return not self.create_empty_blocks or self.create_empty_blocks_interval_s > 0
+
+    def watchdog_stall_s(self) -> float:
+        """Seconds of no-commit progress before the stall watchdog may
+        recover. TMTPU_WATCHDOG_STALL_S overrides as an absolute value
+        (chaos tests shrink it without rewriting config files)."""
+        env = os.environ.get("TMTPU_WATCHDOG_STALL_S")
+        if env:
+            return float(env)
+        expected = self.timeout_commit_s + self.timeout_propose_s
+        return self.watchdog_stall_multiple * max(expected, 0.1)
 
 
 @dataclass

@@ -16,7 +16,7 @@ import time
 from dataclasses import dataclass, field
 
 from tendermint_tpu.consensus import cstypes
-from tendermint_tpu.consensus.state_machine import ConsensusState
+from tendermint_tpu.consensus.state_machine import ConsensusState, commit_to_vote_set
 from tendermint_tpu.encoding import proto
 from tendermint_tpu.utils.bits import BitArray
 from tendermint_tpu.p2p.connection import ChannelDescriptor
@@ -217,6 +217,16 @@ class ConsensusReactor(Reactor):
         """Called by the fast-sync reactor when caught up (reference:
         consensus/reactor.go:108-140)."""
         if state.last_block_height > self.cs.state.last_block_height:
+            # Reconstruct LastCommit from the stored seen commit (reference:
+            # reactor.go:120 reconstructLastCommit): whatever rs.last_commit
+            # held belongs to a height fast sync just skipped past, and a
+            # stale vote set must never be packed into a future proposal.
+            if state.last_block_height > 0:
+                seen = self.cs.block_store.load_seen_commit(
+                    state.last_block_height)
+                if seen is not None and state.last_validators is not None:
+                    self.cs.rs.last_commit = commit_to_vote_set(
+                        state.chain_id, seen, state.last_validators)
             self.cs.update_to_state(state)
         self.wait_sync = False
         self.cs.start()

@@ -232,6 +232,7 @@ class ConsensusState:
 
     def start(self) -> None:
         """reference: consensus/state.go:299-420 OnStart + startRoutines."""
+        self._ticker.resume()  # no-op unless pause() stopped it
         if self.wal is not None and self.state is not None:
             # Empty WAL gets a height-0 end marker so crash replay works for
             # the very first height (reference: consensus/wal.go OnStart).
@@ -239,6 +240,12 @@ class ConsensusState:
                 self.wal.write_sync(EndHeightMessage(0), _time.time_ns())
             self._catchup_replay(self.rs.height)
         self._running = True
+        if self._thread is not None and self._thread.is_alive():
+            # a pause() timed out joining a blocked receive routine: it
+            # re-reads _running when it unblocks and simply resumes —
+            # adopting it keeps the one-drainer invariant
+            self._schedule_round_0()
+            return
         self._thread = threading.Thread(
             target=self._receive_routine, name="cs-receive", daemon=True
         )
@@ -253,6 +260,34 @@ class ConsensusState:
             self._thread.join(timeout=5)
         if self.wal is not None:
             self.wal.close()
+
+    def pause(self) -> None:
+        """Stop the receive routine and ticker WITHOUT closing the WAL, so
+        a later start() resumes cleanly. This is the stall watchdog's
+        hand-back: consensus pauses, fast sync pulls the missing blocks,
+        and switch_to_consensus restarts this machine at the tip."""
+        self._running = False
+        self._ticker.stop()
+        self._msg_queue.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            if not self._thread.is_alive():
+                self._thread = None
+            # else: the routine is blocked past the join budget — KEEP the
+            # handle so start() can adopt it instead of racing a second
+            # drainer against it (two threads mutating rs would fork us)
+
+    def rewind_for_catchup(self) -> None:
+        """Drop in-height commit progress so a fast-sync catchup can
+        update_to_state PAST this height. A node stalled mid-commit (2/3
+        precommits seen but the block never arrived — the classic
+        partition stall) holds commit_round > -1, which update_to_state
+        treats as \"about to commit THIS height\" and refuses to skip;
+        after the hand-back the pipeline applies the height from a peer's
+        stored commit instead, so that claim is void."""
+        with self._mtx:
+            self.rs.commit_round = -1
+            self.rs.triggered_timeout_precommit = False
 
     def wait_sync(self, timeout: float = 1.0) -> None:
         """Drain the queues (test helper): returns once queued work at call
@@ -332,7 +367,12 @@ class ConsensusState:
                             continue
             if mi is None:
                 self._flush_pending_votes()
-                return  # stop sentinel
+                if not self._running:
+                    return  # stop sentinel
+                # stale wake-up sentinel from a previous pause()/stop():
+                # a RESTARTED routine (watchdog hand-back) must not let it
+                # silently kill the new thread
+                continue
             if isinstance(mi, tuple):
                 kind, payload = mi
                 if kind == "__sync__":

@@ -64,3 +64,9 @@ class TimeoutTicker:
                 self._timer.cancel()
                 self._timer = None
             self._current = None
+
+    def resume(self) -> None:
+        """Accept schedules again after stop() (the stall watchdog pauses
+        consensus for a fast-sync catchup, then restarts it)."""
+        with self._mtx:
+            self._stopped = False

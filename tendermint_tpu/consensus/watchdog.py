@@ -1,0 +1,143 @@
+"""Consensus stall watchdog: detect no-commit progress behind a partition
+and hand the node back to fast-sync catchup (no reference analogue — the
+reference node spins rounds forever when it falls behind a healed
+partition until consensus catchup gossip drags it forward height by
+height; the verify-ahead fast-sync pipeline is a far faster road home).
+
+Detection: the committed height (block_store.height) has not advanced for
+``config.watchdog_stall_s()`` seconds AND some peer reports a height at
+least ``config.watchdog_peer_lead`` ahead. Peer heights come from both
+live sources a node already maintains: the consensus reactor's per-peer
+round state (NewRoundStep gossip) and the fast-sync pool's status
+responses. Both are push-updated, so within moments of a heal the majority
+side's lead is visible here.
+
+The peer-lead requirement is what makes the watchdog safe: a node that is
+merely partitioned (peers stale or absent) must NOT thrash into fast sync
+— there is nothing to sync from. Only the combination "I am stalled AND a
+reachable peer is provably ahead" triggers the hand-back, and recovery is
+the node's own fast-sync + verify-ahead machinery, not a restart.
+
+Metrics (wired through utils/metrics.py when instrumentation is on):
+``tendermint_consensus_stalled`` gauge (1 while stalled) and
+``tendermint_consensus_watchdog_recoveries_total`` counter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class ConsensusWatchdog:
+    """Monitors one node; ``recover_fn`` is Node.handoff_to_fastsync."""
+
+    def __init__(self, config, block_store, consensus_reactor, bc_reactor,
+                 recover_fn, metrics=None, logger=None,
+                 check_interval_s: float = 0.25):
+        self.config = config
+        self.block_store = block_store
+        self.consensus_reactor = consensus_reactor
+        self.bc_reactor = bc_reactor
+        self.recover_fn = recover_fn
+        self.metrics = metrics
+        self.logger = logger
+        self.check_interval_s = check_interval_s
+        self.recoveries = 0
+        self.stalled = False
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._last_probe = 0.0
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self.config.watchdog_stall_multiple <= 0:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="cs-watchdog", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+
+    # --- detection ---------------------------------------------------------
+
+    def peer_max_height(self) -> int:
+        """Best height any connected peer reports, from consensus round
+        gossip and fast-sync status responses."""
+        best = 0
+        states = getattr(self.consensus_reactor, "_peer_states", {})
+        for ps in list(states.values()):
+            best = max(best, ps.prs.height)
+        pool = getattr(self.bc_reactor, "pool", None)
+        if pool is not None:
+            best = max(best, pool.max_peer_height())
+        return best
+
+    def probe_peer_heights(self) -> None:
+        """Actively solicit heights: nobody broadcasts StatusRequest
+        outside fast sync, so a stalled node's pool view of its peers goes
+        stale exactly when it matters. The responses land in the pool via
+        the blockchain reactor's always-on receive path."""
+        sw = getattr(self.bc_reactor, "switch", None)
+        if sw is None:
+            return
+        from tendermint_tpu.blockchain.reactor import (
+            BLOCKCHAIN_CHANNEL,
+            msg_status_request,
+        )
+
+        sw.broadcast(BLOCKCHAIN_CHANNEL, msg_status_request())
+
+    def _set_stalled(self, stalled: bool) -> None:
+        if stalled == self.stalled:
+            return
+        self.stalled = stalled
+        if self.metrics is not None:
+            self.metrics.consensus_stalled.set(1.0 if stalled else 0.0)
+
+    def _loop(self) -> None:
+        last_h = self.block_store.height
+        last_t = time.monotonic()
+        while self._running:
+            time.sleep(self.check_interval_s)
+            try:
+                h = self.block_store.height
+                now = time.monotonic()
+                if h > last_h or self.consensus_reactor.wait_sync:
+                    # progress, or a sync (ours or state sync) already owns
+                    # recovery -- restart the stall clock either way
+                    last_h, last_t = h, now
+                    self._set_stalled(False)
+                    continue
+                if now - last_t < self.config.watchdog_stall_s():
+                    continue
+                self._set_stalled(True)
+                lead = self.peer_max_height() - h
+                if lead < self.config.watchdog_peer_lead:
+                    # stalled but nobody provably ahead: hold position and
+                    # ask the peers for their heights directly (rate-limited
+                    # — a long partition must not turn the check cadence
+                    # into a broadcast storm)
+                    if now - self._last_probe >= 1.0:
+                        self._last_probe = now
+                        self.probe_peer_heights()
+                    continue
+                self.recoveries += 1
+                if self.metrics is not None:
+                    self.metrics.watchdog_recoveries.add(1)
+                if self.logger is not None:
+                    self.logger.info("watchdog: consensus stalled, handing "
+                                     "back to fast sync",
+                                     height=h, peer_lead=lead)
+                self.recover_fn()
+                last_h, last_t = self.block_store.height, time.monotonic()
+                self._set_stalled(False)
+            except Exception as e:  # noqa: BLE001 - the watchdog must never
+                # kill a node; a failed recovery retries after the next
+                # full stall window
+                if self.logger is not None:
+                    self.logger.error("watchdog recovery failed", err=e)
+                last_t = time.monotonic()

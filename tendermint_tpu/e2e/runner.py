@@ -97,9 +97,14 @@ def wait_progress(value_fn, done_fn, idle_budget_s: float, hard_cap_s: float,
 @dataclass
 class Perturbation:
     node: int
-    action: str  # kill | restart | pause
+    action: str  # kill | restart | pause | partition | heal
     at_height: int
     revive_after_s: float = 1.0
+    # partition only: groups of node INDICES, e.g. [[0, 1], [2, 3]];
+    # omitted -> isolate `node` from everyone else. Installed symmetrically
+    # on every running node via the unsafe_nemesis RPC and healed at
+    # revive_after_s (or by an explicit heal perturbation).
+    groups: list = field(default_factory=list)
 
 
 @dataclass
@@ -185,17 +190,19 @@ class Runner:
             raise RuntimeError("testnet setup failed")
         # default_config already uses the durable sqlite backend, so
         # kill/restart exercises real recovery; nothing to patch.
-        if self.m.fastsync_version != "v0":
-            from tendermint_tpu.config.config import default_config
-            from tendermint_tpu.config.toml import (
-                load_toml_into, write_config_toml)
+        from tendermint_tpu.config.config import default_config
+        from tendermint_tpu.config.toml import (
+            load_toml_into, write_config_toml)
 
-            for i in range(self.m.validators):
-                home = os.path.join(self.workdir, f"node{i}")
-                path = os.path.join(home, "config", "config.toml")
-                cfg = load_toml_into(default_config().set_root(home), path)
-                cfg.fastsync.version = self.m.fastsync_version
-                write_config_toml(cfg, path)
+        for i in range(self.m.validators):
+            home = os.path.join(self.workdir, f"node{i}")
+            path = os.path.join(home, "config", "config.toml")
+            cfg = load_toml_into(default_config().set_root(home), path)
+            cfg.fastsync.version = self.m.fastsync_version
+            # localhost chaos harness: the partition/heal perturbations
+            # drive each node's nemesis plane over the unsafe RPC route
+            cfg.rpc.unsafe = True
+            write_config_toml(cfg, path)
 
     def _spawn(self, i: int) -> subprocess.Popen:
         env = {**os.environ, "JAX_PLATFORMS": "cpu",
@@ -313,6 +320,17 @@ class Runner:
             tick=tick)
 
     def _apply(self, p: Perturbation, revive_at: list) -> None:
+        if p.action == "partition":
+            groups = p.groups or [[p.node],
+                                  [i for i in range(self.m.validators)
+                                   if i != p.node]]
+            self.partition(groups)
+            revive_at.append((time.monotonic() + p.revive_after_s,
+                              p.node, p.action))
+            return
+        if p.action == "heal":
+            self.heal()
+            return
         proc = self.procs.get(p.node)
         if proc is None:
             return
@@ -335,6 +353,48 @@ class Runner:
         elif action == "pause":
             self.procs[node].send_signal(signal.SIGCONT)
             self._paused.discard(node)
+        elif action == "partition":
+            self.heal()
+
+    # --- nemesis control (reference: runner/perturb.go drives docker
+    # network disconnects; here each node's link plane over unsafe RPC) ----
+
+    def node_ids(self) -> dict[int, str]:
+        """node index -> p2p node id, from each node's status RPC."""
+        ids = {}
+        for i in list(self.rpc_addrs):
+            try:
+                st = self._rpc(i, "status", {})
+                ids[i] = st["node_info"]["id"]
+            except Exception:  # noqa: BLE001 - dead/paused node
+                continue
+        return ids
+
+    def _nemesis_all(self, params: dict) -> None:
+        """Install the same nemesis command on every reachable node — a
+        partition is a property of the NETWORK, so every member must agree
+        on the cut for it to be symmetric."""
+        for i in list(self.rpc_addrs):
+            if i in self._paused or self.procs.get(i) is None:
+                continue
+            try:
+                self._rpc(i, "unsafe_nemesis", params)
+            except Exception:  # noqa: BLE001 - a dead node needs no cut
+                continue
+
+    def partition(self, groups: list) -> None:
+        """Cut the network into groups of node INDICES (e.g. [[0,1],[2,3]]):
+        messages and dials between different groups are dropped on every
+        node until heal()."""
+        ids = self.node_ids()
+        id_groups = [[ids[i] for i in g if i in ids] for g in groups]
+        id_groups = [g for g in id_groups if g]
+        self._nemesis_all({"partition": id_groups})
+
+    def heal(self) -> None:
+        """Remove the partition on every node (persistent-peer backoff is
+        kicked node-side so links re-establish promptly)."""
+        self._nemesis_all({"heal": True})
 
     # --- checks (reference: test/e2e/tests/) --------------------------------
 
@@ -359,6 +419,53 @@ class Runner:
                 continue
         assert len(hashes) >= 2, f"too few reachable nodes: {hashes}"
         assert len(set(hashes.values())) == 1, f"fork detected: {hashes}"
+
+    def audit_agreement(self, min_height: int = 1) -> int:
+        """The BFT safety audit: block-hash agreement across EVERY
+        committed height on all reachable nodes, not one sampled height —
+        a fork at any height anywhere is a safety violation the
+        single-height check can miss (nodes can agree at h and have forked
+        at h-3). A node that hasn't committed a height yet simply doesn't
+        vote for it. Returns the number of heights audited; raises
+        AssertionError with the full per-node hash map on any fork."""
+        max_h = self.max_height()
+        audited = 0
+        for h in range(min_height, max_h + 1):
+            hashes = {}
+            for i in list(self.rpc_addrs):
+                try:
+                    b = self._rpc(i, "block", {"height": str(h)})
+                    hashes[i] = b["block_id"]["hash"]
+                except Exception:  # noqa: BLE001 - not committed there yet
+                    continue
+            if len(hashes) >= 2:
+                audited += 1
+                assert len(set(hashes.values())) == 1, (
+                    f"fork at height {h}: {hashes}")
+        assert audited >= 1, f"no height auditable across nodes (max {max_h})"
+        return audited
+
+    def min_height(self) -> int:
+        """Lowest latest-height over the reachable nodes (−1: none)."""
+        worst = None
+        for i in list(self.rpc_addrs):
+            try:
+                st = self._rpc(i, "status", {})
+                h = int(st["sync_info"]["latest_block_height"])
+                worst = h if worst is None else min(worst, h)
+            except Exception:  # noqa: BLE001
+                continue
+        return -1 if worst is None else worst
+
+    def assert_liveness(self, delta: int = 2, within_s: float = 30.0) -> None:
+        """Post-heal liveness bound: every node catches up to within
+        `delta` heights of the max height within `within_s` (load-scaled
+        idle budget; hard cap 4x)."""
+        self._progress_wait(
+            self.min_height,
+            lambda _h: self.min_height() >= self.max_height() - delta,
+            idle_budget_s=within_s, hard_cap_s=within_s * 4.0,
+            what=f"all nodes within {delta} heights of the tip")
 
     def join_statesync_node(self, timeout_s: float = 120.0) -> int:
         """Spawn a NEW non-validator node that joins the live net via state
@@ -483,9 +590,12 @@ def run_manifest(manifest: Manifest, workdir: str,
     try:
         r.load()
         r.perturb_and_wait()
-        r.assert_consistent(max(manifest.target_height - 2, 1))
+        # full-prefix safety audit: every crash/pause/partition matrix run
+        # gets fork detection at EVERY committed height, not one sample
+        audited = r.audit_agreement()
         if with_load_report:
             report = r.load_report()
+        report["heights_audited"] = audited
         if manifest.statesync_joiner:
             report["joiner_index"] = r.join_statesync_node()
     finally:

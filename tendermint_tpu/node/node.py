@@ -281,6 +281,15 @@ class Node:
         self.rpc_server = None
         self._tx_notify_thread = None
 
+        # consensus stall watchdog (consensus/watchdog.py): a node stalled
+        # behind a healed partition hands itself back to fast-sync catchup
+        from tendermint_tpu.consensus.watchdog import ConsensusWatchdog
+
+        self.watchdog = ConsensusWatchdog(
+            config.consensus, self.block_store, self.consensus_reactor,
+            self.bc_reactor, self.handoff_to_fastsync,
+            metrics=self.metrics, logger=logger)
+
     def install_misbehavior(self, name: str) -> None:
         """Maverick mode: make THIS node byzantine (reference:
         test/maverick/consensus/misbehavior.go, selected per node via the
@@ -313,9 +322,10 @@ class Node:
         # Chaos layer: (re)load TMTPU_FAULTS/TMTPU_FAULT_SEED so every node
         # process starts its fault-site hit counters from zero -- a crash
         # matrix run is then replayable from the env spec + seed alone.
-        from tendermint_tpu.utils import faults
+        from tendermint_tpu.utils import faults, nemesis
 
         faults.install_from_env()
+        nemesis.install_from_env()
         # AOT-warm the batch-verify kernel off the critical path so the first
         # real commit at a warm bucket size is a compile-cache hit
         # (reference has no analogue; XLA compilation is TPU-build-specific).
@@ -343,6 +353,7 @@ class Node:
             self.consensus.start()
         else:
             self.bc_reactor.start_sync()
+        self.watchdog.start()
         if self.mempool.txs_available() is not None:
             import threading
 
@@ -385,6 +396,7 @@ class Node:
 
     def stop(self) -> None:
         self._running = False
+        self.watchdog.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()
         if getattr(self, "grpc_server", None) is not None:
@@ -405,11 +417,29 @@ class Node:
         """Gauge sampling loop; histograms are fed at their call sites
         (reference wires metrics structs through constructors -- a sampler
         keeps the hot paths free of metric plumbing)."""
+        import sys
         import time as _t
+
+        from tendermint_tpu.utils import faults as _faults
+        from tendermint_tpu.utils import nemesis as _nemesis
 
         m = self.metrics
         last_height = self.block_store.height
         last_height_t = _t.monotonic()
+        # chaos counters are sampled as deltas against the layers' own
+        # monotonic counts, so /metrics stays a true Prometheus counter
+        last_site_hits: dict = {}
+        last_fired: dict = {}
+        last_nemesis_fired: dict = {}
+
+        def _pump_counter(counter, now_counts, last_counts, label_fn):
+            for key, n in now_counts.items():
+                delta = n - last_counts.get(key, 0)
+                if delta > 0:
+                    counter.add(delta, **label_fn(key))
+            last_counts.clear()
+            last_counts.update(now_counts)
+
         while self._running:
             try:
                 h = self.block_store.height
@@ -430,9 +460,42 @@ class Node:
                 m.mempool_size.set(self.mempool.size())
                 m.peers.set(len(self.switch.peers))
                 m.rounds.set(getattr(self.consensus.rs, "round", 0))
+                # chaos observability: fault-layer hit/fired counts and
+                # nemesis link-plane firings, as counter deltas
+                hits, fired = _faults.snapshot()
+                _pump_counter(m.fault_site_hits, hits, last_site_hits,
+                              lambda site: {"site": site})
+                _pump_counter(m.faults_fired, fired, last_fired,
+                              lambda k: {"site": k[0], "action": k[1]})
+                _, nem_fired = _nemesis.PLANE.snapshot()
+                _pump_counter(m.nemesis_fired, nem_fired, last_nemesis_fired,
+                              lambda k: {"site": k[0], "action": k[1]})
+                # device breaker state: only meaningful once a kernel
+                # module is loaded; never force the import from a sampler
+                for kernel in ("ed25519", "sr25519"):
+                    kmod = sys.modules.get(f"tendermint_tpu.ops.{kernel}_batch")
+                    if kmod is not None:
+                        m.breaker_open.set(
+                            1.0 if kmod.BREAKER.is_open else 0.0, kernel=kernel)
+                        m.breaker_trips.set(kmod.BREAKER.trips, kernel=kernel)
             except Exception:  # noqa: BLE001 - sampling must never kill a node
                 pass
             _t.sleep(0.25)
+
+    # --- watchdog recovery -------------------------------------------------
+
+    def handoff_to_fastsync(self) -> None:
+        """Stall-watchdog recovery: pause the spinning consensus machine
+        and re-enter fast-sync catchup — the block pool + verify-ahead
+        pipeline pull the missing heights from peers' stored commits, then
+        switch_to_consensus restarts consensus at the tip. No process
+        restart, no WAL close; the consensus reactor's wait_sync latch
+        keeps vote/proposal handling quiet while the pipeline owns the
+        store."""
+        self.consensus_reactor.wait_sync = True
+        self.consensus.pause()
+        self.consensus.rewind_for_catchup()
+        self.bc_reactor.switch_to_fast_sync(self.state_store.load())
 
     # --- state sync --------------------------------------------------------
 

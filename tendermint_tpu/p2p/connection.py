@@ -77,13 +77,20 @@ class MConnection:
 
     def __init__(self, conn, channels: list[ChannelDescriptor], on_receive,
                  on_error=None, send_rate: int = DEFAULT_SEND_RATE,
-                 recv_rate: int = DEFAULT_RECV_RATE):
+                 recv_rate: int = DEFAULT_RECV_RATE,
+                 local_id: str = "", remote_id: str = ""):
         self._conn = conn
+        # peer-id context for the link-scoped fault plane (utils/nemesis.py):
+        # which directed link this connection is, so a partition can cut
+        # exactly the messages crossing it
+        self._local_id = local_id
+        self._remote_id = remote_id
         self._channels = {d.id: _Channel(d) for d in channels}
         self._on_receive = on_receive
         self._on_error = on_error
         self._send_event = threading.Event()
         self._running = False
+        self._stopped = False  # terminal: stop() or a transport error
         self._send_thread: threading.Thread | None = None
         self._recv_thread: threading.Thread | None = None
         self._last_recv = time.monotonic()
@@ -103,6 +110,7 @@ class MConnection:
         self._recv_thread.start()
 
     def stop(self) -> None:
+        self._stopped = True
         self._running = False
         self._send_event.set()
         self._conn.close()
@@ -110,23 +118,35 @@ class MConnection:
     # --- sending -----------------------------------------------------------
 
     def send(self, ch_id: int, msg: bytes, block: bool = True) -> bool:
-        """Queue a message on a channel (reference: connection.go:250-290)."""
+        """Queue a message on a channel (reference: connection.go:250-290).
+        Queuing is allowed BEFORE start(): the switch attaches reactors
+        (which send their hello messages — status, NewRoundStep) before it
+        starts the connection, so no peer can deliver bytes to a reactor
+        that hasn't attached its per-peer state yet; the send routine
+        drains the queues once start() runs."""
         ch = self._channels.get(ch_id)
-        if ch is None or not self._running:
+        if ch is None or self._stopped:
             return False
         try:
-            if faults.maybe_drop("p2p.send"):
-                return True  # loss after send: the caller sees success
+            verdict = faults.link_outcome("p2p.send", self._local_id,
+                                          self._remote_id, channel=ch_id)
         except faults.FaultDisconnect as e:
             # documented disconnect semantics: a transport-style teardown
             # (peer removal + reconnect), never an exception into the
             # arbitrary sending thread (gossip loops have no handler)
             self._die(e)
             return False
+        if verdict == "drop":
+            return True  # loss after send: the caller sees success
         try:
             ch.send_queue.put(msg, block=block, timeout=10 if block else None)
         except queue.Full:
             return False
+        if verdict == "dup":
+            try:
+                ch.send_queue.put(msg, block=False)
+            except queue.Full:
+                pass  # duplication is best-effort; the original made it in
         self._send_event.set()
         return True
 
@@ -236,17 +256,26 @@ class MConnection:
                     if eof:
                         msg = bytes(ch.recving)
                         ch.recving = bytearray()
-                        # drop skips delivery; disconnect raises into _die,
-                        # which tears the peer down like a transport error
-                        if not faults.maybe_drop("p2p.recv"):
+                        # drop skips delivery; dup delivers twice;
+                        # disconnect raises into _die, which tears the
+                        # peer down like a transport error
+                        verdict = faults.link_outcome(
+                            "p2p.recv", self._local_id, self._remote_id,
+                            channel=ch_id)
+                        if verdict != "drop":
                             self._on_receive(ch_id, msg)
+                            if verdict == "dup":
+                                self._on_receive(ch_id, msg)
                 self._last_recv = time.monotonic()
         except Exception as e:  # noqa: BLE001
             self._die(e)
 
     def _die(self, err: Exception) -> None:
-        if not self._running:
+        # gates on the terminal flag, not _running: a fatal fault on a
+        # message queued BEFORE start() must still tear the peer down
+        if self._stopped:
             return
+        self._stopped = True
         self._running = False
         try:
             self._conn.close()

@@ -63,7 +63,7 @@ class Peer:
                  channels: list[ChannelDescriptor], on_receive, on_error,
                  outbound: bool, persistent: bool = False,
                  socket_addr: str = "", send_rate: int = 5_120_000,
-                 recv_rate: int = 5_120_000):
+                 recv_rate: int = 5_120_000, local_id: str = ""):
         self.node_info = node_info
         self.outbound = outbound
         self.persistent = persistent
@@ -74,6 +74,7 @@ class Peer:
             on_receive=lambda ch, msg: on_receive(ch, self, msg),
             on_error=lambda err: on_error(self, err),
             send_rate=send_rate, recv_rate=recv_rate,
+            local_id=local_id, remote_id=node_info.node_id,
         )
 
     @property
@@ -131,7 +132,10 @@ class Transport:
         return self._upgrade(raw, f"{addr[0]}:{addr[1]}")
 
     def dial(self, addr: str) -> tuple[SecretConnection, NodeInfo, str]:
-        faults.fire("p2p.dial")
+        # peer-id context: an "id@host:port" addr names the remote, so a
+        # nemesis partition can refuse dials across the cut
+        faults.fire("p2p.dial", local=self.node_info.node_id,
+                    remote=addr.split("@", 1)[0] if "@" in addr else "")
         host, port = _split_addr(addr)
         raw = socket.create_connection((host, port), timeout=self.dial_timeout_s)
         return self._upgrade(raw, f"{host}:{port}")
@@ -211,6 +215,11 @@ class Switch:
         self._persistent_addrs: list[str] = []
         self._accept_thread: threading.Thread | None = None
         self._reconnect_thread: threading.Thread | None = None
+        # Redial backoff state, instance-level so kick_reconnect() can wipe
+        # it (a nemesis heal must not wait out the clamped max backoff
+        # accumulated while the partition blocked every dial).
+        self._reconnect_attempts: dict[str, int] = {}
+        self._reconnect_next_try: dict[str, float] = {}
 
     # --- registry ----------------------------------------------------------
 
@@ -236,9 +245,21 @@ class Switch:
             self._accept_thread.start()
         self._reconnect_thread = threading.Thread(target=self._reconnect_loop, daemon=True)
         self._reconnect_thread.start()
+        # A healed partition should reconnect promptly, not after the max
+        # backoff the cut accumulated (lazy import: nemesis is pure stdlib,
+        # but keep the switch importable standalone all the same).
+        from tendermint_tpu.utils import nemesis
+
+        nemesis.PLANE.on_heal.append(self.kick_reconnect)
 
     def stop(self) -> None:
         self._running = False
+        from tendermint_tpu.utils import nemesis
+
+        try:
+            nemesis.PLANE.on_heal.remove(self.kick_reconnect)
+        except ValueError:
+            pass
         for r in self.reactors.values():
             r.on_stop()
         with self._peers_mtx:
@@ -279,15 +300,22 @@ class Switch:
             except Exception:  # noqa: BLE001
                 conn.close()
 
+    def kick_reconnect(self) -> None:
+        """Forget all redial backoff state so every missing persistent peer
+        is retried on the next pass (≤0.25 s). Called on nemesis heal: a
+        peer redialed throughout a long partition sits at the clamped max
+        backoff, and a healed link must not wait that out."""
+        self._reconnect_attempts.clear()
+        self._reconnect_next_try.clear()
+
     def _reconnect_loop(self) -> None:
         """Redial missing persistent peers with exponential backoff +
         jitter; a successful dial (or the peer appearing inbound) resets
         that address's schedule."""
-        attempts: dict[str, int] = {}
-        next_try: dict[str, float] = {}
         while self._running:
             try:
-                self._reconnect_pass(attempts, next_try)
+                self._reconnect_pass(self._reconnect_attempts,
+                                     self._reconnect_next_try)
             except Exception as e:  # noqa: BLE001 - the redial thread must
                 # survive anything; losing it silently strands every
                 # persistent peer for the rest of the process lifetime
@@ -310,6 +338,9 @@ class Switch:
             if now < next_try.get(addr, 0.0):
                 continue
             if self.dial_peer(addr, persistent=True) is not None:
+                # reset the attempt counter on success: the NEXT outage of
+                # this link starts its backoff from scratch instead of
+                # inheriting the clamped max from the previous one
                 attempts.pop(addr, None)
                 next_try.pop(addr, None)
             else:
@@ -329,11 +360,18 @@ class Switch:
                 raise P2PError("duplicate peer")
             peer = Peer(conn, peer_info, self._channels, self._on_receive,
                         self._on_peer_error, outbound, persistent, socket_addr,
-                        send_rate=self.send_rate, recv_rate=self.recv_rate)
+                        send_rate=self.send_rate, recv_rate=self.recv_rate,
+                        local_id=self.transport.node_info.node_id)
             self.peers[peer.id] = peer
-        peer.start()
+        # Reactors attach their per-peer state (and queue their hello
+        # messages) BEFORE the connection starts reading: bytes the remote
+        # already sent — its status, its NewRoundStep — must not reach a
+        # reactor whose add_peer hasn't run yet, or a peer that never
+        # re-announces (parked at a height) stays invisible forever
+        # (reference: the InitPeer/AddPeer split of p2p/switch.go:840).
         for r in self.reactors.values():
             r.add_peer(peer)
+        peer.start()
         return peer
 
     # --- peer events -------------------------------------------------------

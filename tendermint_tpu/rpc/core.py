@@ -656,6 +656,34 @@ def unsafe_flush_mempool(env):
     return {}
 
 
+def unsafe_nemesis(env, partition=None, heal=False, links=None):
+    """Drive this node's peer-scoped link fault plane (utils/nemesis.py;
+    no reference analogue — the e2e runner's partition/heal perturbations
+    land here, the way runner/perturb.go drives docker network disconnects
+    in the reference's containerized e2e).
+
+    ``partition``: list of groups, each a list of node-id prefixes —
+    installed symmetrically on every node of a testnet it cuts the links
+    between groups. ``heal``: remove the partition (and re-kick persistent
+    redials). ``links``: list of "src>dst:action[~p][%prob]" specs."""
+    _require_unsafe(env)
+    from tendermint_tpu.utils import nemesis
+
+    if heal:
+        nemesis.heal()
+    if partition is not None:
+        if (not isinstance(partition, list)
+                or not all(isinstance(g, list) and g for g in partition)):
+            raise ValueError("partition must be a list of non-empty groups")
+        nemesis.partition(partition)
+    if links is not None:
+        if not isinstance(links, list):
+            raise ValueError("links must be a list of src>dst:action specs")
+        for spec in links:
+            nemesis.add_link(spec)
+    return nemesis.PLANE.describe()
+
+
 ROUTES = {
     "health": health,
     "status": status,
@@ -690,4 +718,5 @@ ROUTES = {
     "dial_seeds": dial_seeds,
     "dial_peers": dial_peers,
     "unsafe_flush_mempool": unsafe_flush_mempool,
+    "unsafe_nemesis": unsafe_nemesis,
 }

@@ -239,6 +239,20 @@ class Registry:
 
     # --- the decision point ------------------------------------------------
 
+    def snapshot(self) -> tuple[dict[str, int], dict[tuple[str, str], int]]:
+        """Observability: (per-site hit counts, fired counts keyed
+        (site, action)). Consumed by the node metrics sampler so chaos runs
+        are visible on the /metrics route."""
+        with self._lock:
+            hits = dict(self._hits)
+            fired: dict[tuple[str, str], int] = {}
+            for site, rs in self._rules.items():
+                for r in rs:
+                    if r.fired:
+                        key = (site, r.action)
+                        fired[key] = fired.get(key, 0) + r.fired
+        return hits, fired
+
     def check(self, site: str) -> Hit | None:
         if not self.active:
             return None
@@ -299,6 +313,10 @@ def check(site: str) -> Hit | None:
     return REGISTRY.check(site)
 
 
+def snapshot() -> tuple[dict[str, int], dict[tuple[str, str], int]]:
+    return REGISTRY.snapshot()
+
+
 def _apply(hit: Hit) -> None:
     if hit.action == "crash":
         REGISTRY.crash_fn()
@@ -317,14 +335,24 @@ def _apply(hit: Hit) -> None:
         f"action {hit.action!r} is not supported at site {hit.site!r}")
 
 
-def fire(site: str) -> None:
+def fire(site: str, local: str = "", remote: str = "") -> None:
     """Apply any triggered crash/raise/disconnect/delay rule at ``site``.
     Write-shaped (torn/partial) and message-shaped (drop) actions need the
     site-specific helpers below; a firing that lands here raises
-    FaultError so a misconfigured schedule can never pass silently."""
+    FaultError so a misconfigured schedule can never pass silently.
+
+    ``local``/``remote`` carry peer-id context at the p2p sites; when
+    given, the peer-scoped nemesis plane (utils/nemesis.py) is consulted
+    after the global site rules (a dial across a partition raises
+    FaultInjected here)."""
     hit = REGISTRY.check(site)
     if hit is not None:
         _apply(hit)
+    if local or remote:
+        from tendermint_tpu.utils import nemesis
+
+        if nemesis.PLANE.active:
+            nemesis.PLANE.outcome(site, local, remote)
 
 
 def maybe_drop(site: str) -> bool:
@@ -337,6 +365,22 @@ def maybe_drop(site: str) -> bool:
         return True
     _apply(hit)
     return False
+
+
+def link_outcome(site: str, local: str = "", remote: str = "",
+                 channel: int | None = None) -> str:
+    """Message sites with peer-id context (MConnection send/recv): the
+    global site rules fire first (exact :func:`maybe_drop` semantics),
+    then the peer-scoped nemesis plane. Returns ``'pass'``, ``'drop'``,
+    or ``'dup'`` (deliver twice); delay rules sleep here; disconnect
+    raises FaultDisconnect for the connection error path."""
+    if maybe_drop(site):
+        return "drop"
+    from tendermint_tpu.utils import nemesis
+
+    if not nemesis.PLANE.active:
+        return "pass"
+    return nemesis.PLANE.outcome(site, local, remote, channel)
 
 
 def torn_write(site: str, fobj, frame: bytes) -> None:

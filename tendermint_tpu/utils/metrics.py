@@ -198,6 +198,39 @@ class NodeMetrics:
             "p2p", "peer_receive_bytes_total", "Bytes received.", labels=("chID",))
         self.peer_send_bytes = r.counter(
             "p2p", "peer_send_bytes_total", "Bytes sent.", labels=("chID",))
+        # robustness / chaos (no reference analogue: the fault-injection
+        # layer, nemesis link plane, device breaker, and stall watchdog
+        # are this tree's own; chaos runs must be visible on /metrics)
+        self.consensus_stalled = r.gauge(
+            "consensus", "stalled",
+            "1 while the stall watchdog sees no commit progress.")
+        self.watchdog_recoveries = r.counter(
+            "consensus", "watchdog_recoveries_total",
+            "Stall-watchdog hand-backs to fast-sync catchup.")
+        self.fault_site_hits = r.counter(
+            "faults", "site_hits_total",
+            "Hits at rule-bearing fault sites (utils/faults.py).",
+            labels=("site",))
+        self.faults_fired = r.counter(
+            "faults", "fired_total",
+            "Fault-rule firings by site and action.",
+            labels=("site", "action"))
+        self.nemesis_fired = r.counter(
+            "nemesis", "fired_total",
+            "Nemesis link-plane firings by site and action "
+            "('cut' = partition).", labels=("site", "action"))
+        self.breaker_open = r.gauge(
+            "ops", "breaker_open",
+            "1 while the kernel's device circuit breaker is open.",
+            labels=("kernel",))
+        self.breaker_trips = r.gauge(
+            "ops", "breaker_trips_total",
+            "Lifetime closed->open transitions of the device breaker.",
+            labels=("kernel",))
+        # pre-seed the unlabeled watchdog series so a healthy node scrapes
+        # an explicit 0 instead of an absent metric
+        self.consensus_stalled.set(0.0)
+        self.watchdog_recoveries.add(0.0)
 
 
 # Global registry hook for hot paths that have no handle on the node (the

@@ -1,0 +1,375 @@
+"""Nemesis: the peer-scoped link fault plane (reference: the perturbation
+dimension of test/e2e/ — runner/perturb.go kills processes; this layer cuts
+LINKS, which no process signal can express).
+
+Where utils/faults.py rules fire *globally* per site, nemesis rules are
+keyed by the (local node id, remote peer id) of one directed link. The p2p
+choke points — ``p2p.send``, ``p2p.recv``, ``p2p.dial`` — pass that context
+through :func:`tendermint_tpu.utils.faults.link_outcome`, which consults
+the global site rules first (unchanged semantics) and then this plane. One
+process can therefore host many in-process nodes and still cut exactly the
+links between them, and a real testnet can partition itself symmetrically
+by installing the same groups on every node.
+
+Two fault shapes:
+
+* **Partition** — ``partition(groups)`` installs disjoint groups of
+  node-id prefixes; links between nodes of *different* groups are SEVERED
+  (the first crossing message tears the connection down like a transport
+  error, and dials are refused), exactly what a firewall cut does to TCP.
+  Nodes in no group are unaffected, so partial specs compose. ``heal()``
+  removes the partition and notifies ``on_heal`` listeners (the p2p
+  switch uses this to forget reconnect backoff so healed persistent links
+  redial immediately and rebuild peer gossip state from scratch).
+* **Link rules** — directed ``src>dst`` rules with the faults-style action
+  set: ``drop``, ``delay`` (with seeded jitter), ``dup`` (deliver twice),
+  ``disconnect`` (tear the connection down like a transport error). A rule
+  on one direction only is an asymmetric link; ``%prob`` makes it flap.
+
+Determinism composes with the faults layer: every probabilistic decision
+for hit *k* of a directed link is a pure function of
+``(TMTPU_FAULT_SEED, site, local, remote, k)`` — per-link hit counters make
+schedules independent of thread interleavings across links, exactly like
+the per-site counters of faults.py.
+
+Environment grammar (``TMTPU_NEMESIS``; comma-separated statements):
+
+    partition=<group>|<group>[|...]      group = id-prefix[/id-prefix...]
+    link=<src>><dst>:<action>[~<param>][%<prob>][#<channel>]
+    heal@<seconds>                       auto-heal partitions after t s
+
+    TMTPU_NEMESIS="partition=ab12/cd34|ef56,heal@5"
+    TMTPU_NEMESIS="link=*>ab12:drop%0.3,link=ab12>*:delay~0.05"
+    TMTPU_NEMESIS="link=*>ab12:drop#0x22"   # starve only the vote channel
+
+``src``/``dst`` are node-id prefixes or ``*``. ``delay~p`` sleeps a seeded
+uniform in [p/2, p] (per-link jitter); ``dup`` re-delivers the message
+once; ``disconnect`` raises :class:`faults.FaultDisconnect` into the
+connection error path; ``#ch`` scopes a rule to one mconnection channel
+(a vote-starved-but-reachable peer is a different failure than a dead
+link). The seed is ``TMTPU_FAULT_SEED`` — one seed replays the whole
+chaos schedule, faults and nemesis together.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tendermint_tpu.utils import faults
+
+LINK_SITES = ("p2p.send", "p2p.recv", "p2p.dial")
+_LINK_ACTIONS = {"drop", "delay", "dup", "disconnect"}
+
+
+def _match(pattern: str, node_id: str) -> bool:
+    """Node-id prefix match; '*' matches anything, including an unknown
+    (empty) id. A non-wildcard pattern never matches an unknown id — a
+    link we cannot attribute must not burn a scoped rule's trigger."""
+    if pattern == "*":
+        return True
+    return bool(pattern) and bool(node_id) and node_id.startswith(pattern)
+
+
+@dataclass
+class LinkRule:
+    """One directed link rule: ``src>dst:action[~param][%prob][#ch]``."""
+
+    src: str
+    dst: str
+    action: str
+    param: float | None = None
+    prob: float | None = None
+    ch: int | None = None  # scope to one mconnection channel id
+    fired: int = field(default=0, compare=False)
+
+    @staticmethod
+    def parse(spec: str) -> "LinkRule":
+        """``src>dst:action[~param][%prob][#ch]`` -> LinkRule."""
+        link, sep, rest = spec.strip().partition(":")
+        src, sep2, dst = link.partition(">")
+        action, param, prob, ch = rest, None, None, None
+        if "#" in action:
+            action, _, c = action.partition("#")
+            ch = int(c, 0)  # accepts 0x22 and 34 alike
+        if "%" in action:
+            action, _, p = action.partition("%")
+            prob = float(p)
+        if "~" in action:
+            action, _, p = action.partition("~")
+            param = float(p)
+        if (not sep or not sep2 or not src or not dst
+                or action not in _LINK_ACTIONS):
+            raise ValueError(f"bad link spec {spec!r} "
+                             "(want src>dst:action[~p][%prob][#ch])")
+        return LinkRule(src=src, dst=dst, action=action, param=param,
+                        prob=prob, ch=ch)
+
+
+class NemesisPlane:
+    """Partition groups + directed link rules, consulted by the p2p fault
+    sites with (local, remote) context. ``active`` is a plain attribute so
+    the no-nemesis hot path costs one attribute read."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._groups: list[tuple[str, ...]] = []
+        self._rules: list[LinkRule] = []
+        self._hits: dict[tuple[str, str, str], int] = {}
+        self._fired: dict[tuple[str, str], int] = {}
+        self._heal_timer: threading.Timer | None = None
+        self.active = False
+        self.on_heal: list = []  # callbacks() after a heal()
+
+    # --- configuration -----------------------------------------------------
+
+    def _seed(self) -> int:
+        return faults.REGISTRY.seed
+
+    def partition(self, groups) -> None:
+        """Install a partition: ``groups`` is an iterable of groups, each an
+        iterable of node-id prefixes. Links between different groups are
+        severed (connections torn down, dials refused) until :meth:`heal`."""
+        gs = [tuple(str(g) for g in group) for group in groups if group]
+        with self._lock:
+            self._groups = gs
+            self.active = bool(self._groups or self._rules)
+
+    def heal(self) -> None:
+        """Remove the partition (link rules stay) and notify listeners."""
+        with self._lock:
+            self._groups = []
+            self.active = bool(self._rules)
+            if self._heal_timer is not None:
+                self._heal_timer.cancel()
+                self._heal_timer = None
+            listeners = list(self.on_heal)
+        for cb in listeners:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 - a listener must not block heal
+                pass
+
+    def add_link(self, spec_or_rule) -> LinkRule:
+        """Add one directed link rule (spec string or LinkRule)."""
+        r = (spec_or_rule if isinstance(spec_or_rule, LinkRule)
+             else LinkRule.parse(spec_or_rule))
+        with self._lock:
+            self._rules.append(r)
+            self.active = True
+        return r
+
+    def clear(self) -> None:
+        """Drop everything: partition, link rules, hit counters."""
+        with self._lock:
+            self._groups = []
+            self._rules = []
+            self._hits = {}
+            self._fired = {}
+            self.active = False
+            if self._heal_timer is not None:
+                self._heal_timer.cancel()
+                self._heal_timer = None
+
+    def reset_counters(self) -> None:
+        """Zero hit/fired counters (same rules): seeded replay."""
+        with self._lock:
+            self._hits = {}
+            self._fired = {}
+            for r in self._rules:
+                r.fired = 0
+
+    def configure(self, spec: str) -> None:
+        """Replace the whole plane from a TMTPU_NEMESIS grammar string."""
+        groups: list[tuple[str, ...]] = []
+        rules: list[LinkRule] = []
+        heal_after: float | None = None
+        for stmt in spec.split(","):
+            stmt = stmt.strip()
+            if not stmt:
+                continue
+            if stmt.startswith("partition="):
+                groups = [tuple(p for p in g.split("/") if p)
+                          for g in stmt[len("partition="):].split("|") if g]
+            elif stmt.startswith("link="):
+                rules.append(LinkRule.parse(stmt[len("link="):]))
+            elif stmt.startswith("heal@"):
+                heal_after = float(stmt[len("heal@"):])
+            else:
+                raise ValueError(f"bad nemesis statement {stmt!r} "
+                                 "(want partition=…|…, link=…, or heal@t)")
+        with self._lock:
+            self._groups = groups
+            self._rules = rules
+            self._hits = {}
+            self._fired = {}
+            self.active = bool(groups or rules)
+            if self._heal_timer is not None:
+                self._heal_timer.cancel()
+                self._heal_timer = None
+            if heal_after is not None and groups:
+                self._heal_timer = threading.Timer(heal_after, self.heal)
+                self._heal_timer.daemon = True
+                self._heal_timer.start()
+
+    def install_from_env(self) -> None:
+        """(Re)load TMTPU_NEMESIS. Like faults.install_from_env, an empty
+        env leaves a programmatically-installed plane untouched."""
+        spec = os.environ.get("TMTPU_NEMESIS", "")
+        if not spec.strip():
+            return
+        self.configure(spec)
+
+    # --- the decision point ------------------------------------------------
+
+    def _cut(self, a: str, b: str) -> bool:
+        """True when the partition separates node ids a and b."""
+        ga = gb = None
+        for i, group in enumerate(self._groups):
+            if ga is None and any(_match(p, a) for p in group):
+                ga = i
+            if gb is None and any(_match(p, b) for p in group):
+                gb = i
+        return ga is not None and gb is not None and ga != gb
+
+    def outcome(self, site: str, local: str, remote: str,
+                channel: int | None = None) -> str:
+        """Verdict for one message/dial on the directed link. Returns
+        ``'pass'``, ``'drop'``, or ``'dup'``; sleeps for delay rules;
+        raises FaultDisconnect (disconnect rule) or FaultInjected (a dial
+        across a partition). Direction is message-travel: ``p2p.send`` and
+        ``p2p.dial`` travel local->remote, ``p2p.recv`` remote->local.
+        ``channel`` is the mconnection channel id at the message sites
+        (None at ``p2p.dial``); channel-scoped rules only see it."""
+        if not self.active:
+            return "pass"
+        src, dst = (remote, local) if site == "p2p.recv" else (local, remote)
+        delay: float | None = None
+        verdict = "pass"
+        with self._lock:
+            key = (site, local[:16], remote[:16])
+            idx = self._hits.get(key, 0) + 1
+            self._hits[key] = idx
+            # The per-hit rng is built LAZILY: seeding random.Random from a
+            # string hashes it, and only probabilistic/jittered rules ever
+            # draw — a pure partition must not pay that inside the one
+            # plane-wide lock on every message. Laziness preserves the
+            # determinism contract: the rng still depends only on
+            # (seed, site, link, hit index), and the draw sequence within
+            # a hit is fixed by the rule list.
+            rng: random.Random | None = None
+
+            def _rng() -> random.Random:
+                nonlocal rng
+                if rng is None:
+                    rng = random.Random(f"{self._seed()}:nemesis:{site}:"
+                                        f"{local[:16]}:{remote[:16]}:{idx}")
+                return rng
+
+            if self._groups and self._cut(local, remote):
+                self._fired[(site, "cut")] = self._fired.get((site, "cut"), 0) + 1
+                verdict = "cut"
+            else:
+                for r in self._rules:
+                    if not (_match(r.src, src) and _match(r.dst, dst)):
+                        continue
+                    if r.ch is not None and r.ch != channel:
+                        continue
+                    if r.prob is not None and _rng().random() >= r.prob:
+                        continue
+                    r.fired += 1
+                    self._fired[(site, r.action)] = \
+                        self._fired.get((site, r.action), 0) + 1
+                    if r.action == "delay":
+                        # seeded per-link jitter: uniform in [p/2, p]
+                        p = r.param if r.param is not None else 0.05
+                        delay = p * (0.5 + 0.5 * _rng().random())
+                        continue  # delay composes with a later drop/dup rule
+                    verdict = r.action
+                    break
+        if delay is not None:
+            time.sleep(delay)
+        if verdict == "cut":
+            # A partition SEVERS the link (like the reference e2e's docker
+            # network disconnect): the first crossing message tears the
+            # connection down and redials are refused until heal. Silent
+            # per-message drops would poison gossip bookkeeping — try_send
+            # reports success, peers get marked as having votes they never
+            # saw, and the net deadlocks at the height even after heal.
+            # Teardown + reconnect rebuilds peer state from scratch.
+            if site == "p2p.dial":
+                raise faults.FaultInjected(site)
+            raise faults.FaultDisconnect(site)
+        if verdict == "disconnect":
+            raise faults.FaultDisconnect(site)
+        if verdict == "dup" and site == "p2p.dial":
+            # a duplicated dial makes no sense; a schedule that asks for it
+            # is misconfigured -- fail loudly like faults._apply does
+            raise faults.FaultError(
+                f"action 'dup' is not supported at site {site!r}")
+        return verdict
+
+    # --- observability -----------------------------------------------------
+
+    def snapshot(self) -> tuple[dict, dict]:
+        """(per-link hit counts keyed (site, local, remote), fired counts
+        keyed (site, action)) — consumed by the node metrics sampler."""
+        with self._lock:
+            return dict(self._hits), dict(self._fired)
+
+    def describe(self) -> dict:
+        """JSON-friendly state for the unsafe_nemesis RPC."""
+        with self._lock:
+            return {
+                "active": self.active,
+                "partition": [list(g) for g in self._groups],
+                "links": [f"{r.src}>{r.dst}:{r.action}"
+                          + (f"~{r.param}" if r.param is not None else "")
+                          + (f"%{r.prob}" if r.prob is not None else "")
+                          + (f"#{r.ch:#x}" if r.ch is not None else "")
+                          for r in self._rules],
+                "fired": {f"{site}:{action}": n
+                          for (site, action), n in self._fired.items()},
+            }
+
+
+PLANE = NemesisPlane()
+
+
+# Module-level helpers (mirror utils/faults.py's surface)
+
+def partition(groups) -> None:
+    PLANE.partition(groups)
+
+
+def heal() -> None:
+    PLANE.heal()
+
+
+def add_link(spec_or_rule) -> LinkRule:
+    return PLANE.add_link(spec_or_rule)
+
+
+def clear() -> None:
+    PLANE.clear()
+
+
+def configure(spec: str) -> None:
+    PLANE.configure(spec)
+
+
+def install_from_env() -> None:
+    PLANE.install_from_env()
+
+
+def outcome(site: str, local: str, remote: str,
+            channel: int | None = None) -> str:
+    return PLANE.outcome(site, local, remote, channel)
+
+
+# Like faults, env config is live from import: child processes (e2e nodes)
+# inherit TMTPU_NEMESIS with no wiring call.
+PLANE.install_from_env()

@@ -29,7 +29,9 @@ def test_e2e_testnet_with_perturbations(tmp_path):
         r.load()
         r.perturb_and_wait(timeout_s=240)
         assert r.max_height() >= m.target_height
-        r.assert_consistent(m.target_height - 2)
+        # full-prefix audit: fork detection at EVERY committed height, so
+        # the crash matrix can't miss a fork below the sampled height
+        assert r.audit_agreement() >= m.target_height - 2
     finally:
         r.stop()
 
@@ -53,8 +55,8 @@ def test_e2e_statesync_join(tmp_path):
         st = r._rpc(idx, "status", {})
         # bootstrapped mid-chain: no genesis replay
         assert int(st["sync_info"]["earliest_block_height"]) > 1
-        # agrees with the net
-        r.assert_consistent(m.target_height - 1)
+        # agrees with the net at every height it serves
+        r.audit_agreement()
     finally:
         r.stop()
 
@@ -76,7 +78,7 @@ def test_e2e_byzantine_node_and_load_report(tmp_path):
         r.load()
         r.perturb_and_wait(timeout_s=240)
         assert r.max_height() >= m.target_height
-        r.assert_consistent(m.target_height - 2)
+        r.audit_agreement()
         report = r.load_report(window_s=10.0)
         assert report["blocks"] >= 1 and report["blocks_per_min"] > 0
         assert report["txs_committed"] >= 1
