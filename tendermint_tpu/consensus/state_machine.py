@@ -452,6 +452,7 @@ class ConsensusState:
         always applied before batch k+1, so observable ordering is exactly
         the serial drain's."""
         from tendermint_tpu.crypto import batch as crypto_batch
+        from tendermint_tpu.crypto import sigcache
 
         # Apply the in-flight previous flush FIRST: if it commits and
         # advances the height, a snapshot taken before it would filter every
@@ -462,6 +463,7 @@ class ConsensusState:
         rs = self.rs
         val_set = rs.votes.val_set if rs.votes is not None else None
         height = rs.height
+        dc = sigcache.DrainCache()
         try:
             verifier = crypto_batch.create_batch_verifier()
             queued: list[int] = []
@@ -481,27 +483,47 @@ class ConsensusState:
                 sb = sb_memo.get(sb_key)
                 if sb is None:
                     sb = sb_memo[sb_key] = v.sign_bytes(chain_id)
+                # Gossip re-delivers the same vote from several peers; a
+                # known-verified triple skips straight to the serial
+                # accept-replay (duplicate detection happens there).
+                if dc.check(i, val.pub_key.bytes(), sb, v.signature):
+                    continue
                 verifier.add(val.pub_key, sb, v.signature)
                 queued.append(i)
             if not queued:
-                self._apply_vote_results(msgs, {})
+                # commit with an empty flush: applies the cache hits and
+                # flushes the batched hit/miss metrics deltas
+                self._apply_vote_results(msgs, dc.commit([], []))
                 return
             pending = verifier.dispatch()
             if pending.has_device_output():
                 # stash; the drain loop applies it before the next state
                 # transition, overlapping the round trip with more draining
-                self._pending_flush = (msgs, queued, pending)
+                self._pending_flush = (msgs, queued, dc, pending)
                 return
-            _, bitmap = pending.resolve()
-            ok_by_i = dict(zip(queued, bitmap))
+            ok_by_i = self._resolve_vote_flush(queued, dc, pending)
         except Exception as e:  # noqa: BLE001
             # A flush failure (device OOM, runtime hiccup) must not kill the
             # consensus thread; fall back to per-vote scalar verification.
-            ok_by_i = {}
+            # Cache hits stay verified -- they never touched this flush --
+            # and the empty commit caches nothing but still flushes the
+            # batched hit/miss metric deltas (counters must stay honest
+            # exactly when degradation makes operators read them).
+            ok_by_i = dc.commit([], [])
             if self.logger is not None:
                 self.logger.error("batched vote verify failed; falling back "
                                   "to serial", err=e)
         self._apply_vote_results(msgs, ok_by_i)
+
+    @staticmethod
+    def _resolve_vote_flush(queued, dc, pending):
+        """Resolve a dispatched vote flush into {msg index: verified}.
+        Positively verified triples enter the signature cache in
+        DrainCache.commit -- only from a resolved bitmap, so a resolve that
+        raises (propagated to the caller's serial fallback) can never
+        poison the cache."""
+        _, bitmap = pending.resolve()
+        return dc.commit(queued, bitmap)
 
     def _flush_pending_votes(self, _locked: bool = False) -> None:
         """Fetch and apply the in-flight batched vote flush, if any.
@@ -510,13 +532,11 @@ class ConsensusState:
         if pf is None:
             return
         self._pending_flush = None
-        msgs, queued, pending = pf
-        ok_by_i: dict[int, bool] = {}
+        msgs, queued, dc, pending = pf
         try:
-            _, bitmap = pending.resolve()
-            ok_by_i = dict(zip(queued, bitmap))
+            ok_by_i = self._resolve_vote_flush(queued, dc, pending)
         except Exception as e:  # noqa: BLE001 - same fallback as the sync path
-            ok_by_i = {}
+            ok_by_i = dc.commit([], [])
             if self.logger is not None:
                 self.logger.error("batched vote verify failed; falling back "
                                   "to serial", err=e)

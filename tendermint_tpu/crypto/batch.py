@@ -346,8 +346,36 @@ def warmup(sizes: tuple[int, ...] = (64,), background: bool = True):
                 # which the host route would otherwise absorb
                 ed25519_batch.verify_batch([(pub, b"warmup", sig)] * n,
                                            force_device=True)
+            _warm_mesh(pub, sig)
         except Exception:  # noqa: BLE001 - warmup must never kill a node
             return
+
+    def _warm_mesh(pub, sig):
+        """Compile the multi-device shard_map chunk executables so the first
+        real sharded commit doesn't eat the trace (+compile). One chunk is
+        n_devices * JNP_TILE items; the sharded path only ever runs that one
+        shape, so one warm call per kernel covers every future batch size."""
+        import jax
+
+        from tendermint_tpu.ops import ed25519_batch
+        from tendermint_tpu.parallel import batch_shard
+
+        if jax.local_device_count() < 2 or not batch_shard.shard_enabled():
+            return
+        chunk = jax.local_device_count() * ed25519_batch.JNP_TILE
+        n = max(chunk, batch_shard.shard_threshold(jax.local_device_count()))
+        ed25519_batch.verify_batch([(pub, b"warmup", sig)] * n,
+                                   force_device=True)
+        try:
+            from tendermint_tpu.crypto import sr25519
+            from tendermint_tpu.ops import sr25519_batch
+
+            spriv = sr25519.gen_priv_key(b"\x43" * 32)
+            spub = spriv.pub_key().bytes()
+            ssig = spriv.sign(b"warmup")
+            sr25519_batch.verify_batch([(spub, b"warmup", ssig)] * n)
+        except Exception:  # noqa: BLE001 - sr warm is best-effort
+            pass
 
     if background:
         import threading

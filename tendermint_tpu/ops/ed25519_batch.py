@@ -618,9 +618,9 @@ def _dispatch_device(items, n: int, multichip: bool):
     if multichip:
         # Multi-chip: shard the signature axis over the device mesh
         # (BASELINE.json north_star: validator sets sharded across TPU
-        # cores, pass/fail bitmap all-reduced). Batches smaller than one
-        # MIN_BUCKET per device gain nothing from fan-out and stay on the
-        # single-device path.
+        # cores, pass/fail bitmap all-reduced). Routing policy and knobs
+        # (TM_TPU_SHARD / TM_TPU_SHARD_MIN) live in batch_shard.should_shard;
+        # batches below the threshold stay on the single-device path.
         from tendermint_tpu.parallel import batch_shard
 
         dev = batch_shard.dispatch_batch_sharded(ks, key_idx, items, pub_ok)
@@ -691,10 +691,10 @@ def dispatch_batch(items: list[tuple[bytes, bytes, bytes]],
     accelerator. While open, even force_device callers are degraded."""
     if not items:
         return None, lambda _: np.zeros((0,), dtype=bool)
+    from tendermint_tpu.parallel import batch_shard
+
     n = len(items)
-    ndev = len(jax.devices())
-    multichip = (ndev > 1 and n >= ndev * MIN_BUCKET
-                 and os.environ.get("TM_TPU_DISABLE_SHARD") != "1")
+    multichip = batch_shard.should_shard(n)
     if not multichip and not force_device and n < host_crossover():
         # Below the measured crossover a kernel flush loses to the CPU: the
         # sync floor alone exceeds the C verifier's whole runtime. No device

@@ -157,7 +157,7 @@ def _ristretto_decode_dev(s_limbs):
     return x, y, ok
 
 
-def _sr_verify_kernel(tab, k_win, s_win, r_limbs, valid):
+def _sr_verify_kernel(tab, k_win, s_win, r_limbs, valid, axis_name=None):
     """The jitted batch verify.
 
     tab:     (N, 16, 4, 20) int32  comb table of -A per signature (cached)
@@ -165,6 +165,8 @@ def _sr_verify_kernel(tab, k_win, s_win, r_limbs, valid):
     s_win:   (N, 64) int32   comb windows of s
     r_limbs: (N, 20) int32   field limbs of the sig's 32-byte R encoding
     valid:   (N,)    bool    host-side precheck results
+    axis_name: mesh axis when running inside shard_map (marks the loop carry
+               as device-varying; same plumbing as the ed25519 twin)
     ->       (N,)    bool
     """
     n = tab.shape[0]
@@ -178,7 +180,16 @@ def _sr_verify_kernel(tab, k_win, s_win, r_limbs, valid):
         acc = ed.add(acc, edb._gather_point(tab_b, ws))
         return acc
 
-    acc = jax.lax.fori_loop(0, 64, body, ed.identity((n,)))
+    acc0 = ed.identity((n,))
+    if axis_name is not None:
+        # pvary deprecated for pcast in jax 0.9; jax < 0.5 needs no marking
+        # (varying-manual-axes tracking didn't exist) -- see the ed25519 twin.
+        pcast = getattr(jax.lax, "pcast", None)
+        if pcast is not None:
+            acc0 = pcast(acc0, axis_name, to="varying")
+        elif hasattr(jax.lax, "pvary"):
+            acc0 = jax.lax.pvary(acc0, axis_name)
+    acc = jax.lax.fori_loop(0, 64, body, acc0)
 
     x_r, y_r, ok_r = _ristretto_decode_dev(r_limbs)
     X, Y = acc[..., 0, :], acc[..., 1, :]
@@ -283,7 +294,7 @@ def _host_fallback(items, n):
     return None, lambda _unused: bitmap
 
 
-def _dispatch_device(items, n: int):
+def _dispatch_device(items, n: int, multichip: bool = False):
     """The accelerator route proper; raises on device failure (injected or
     real) -- the circuit breaker in dispatch_batch owns the fallback. The
     fault site fires in dispatch_batch, not here, so the breaker probe
@@ -302,6 +313,17 @@ def _dispatch_device(items, n: int):
     k_win = sc.comb_windows(k32).astype(np.int32)
     s_win = sc.comb_windows(s32).astype(np.int32)
     r_limbs = _bytes_to_limbs(r32)
+
+    if multichip:
+        # Multi-chip: the signature axis shards over the ("dp",) mesh, the
+        # same routing the ed25519 twin takes (policy in
+        # batch_shard.should_shard; comb tables replicate once per set).
+        from tendermint_tpu.parallel import batch_shard
+
+        dev = batch_shard.dispatch_sharded(
+            "sr25519", ks, key_idx, [k_win, s_win, r_limbs, valid], n)
+        edb._start_host_copy(dev)
+        return dev, lambda v: np.asarray(v)[:n]
 
     # Fixed-tile chunking through the one JNP_TILE-shaped executable.
     tile = edb.JNP_TILE
@@ -355,9 +377,12 @@ def dispatch_batch(items: list[tuple[bytes, bytes, bytes]],
     behind the same circuit-breaker degradation as the ed25519 twin."""
     if not items:
         return None, lambda _: np.zeros((0,), dtype=bool)
-    n = len(items)
+    from tendermint_tpu.parallel import batch_shard
 
-    if not force_device and n < edb.host_crossover():
+    n = len(items)
+    multichip = batch_shard.should_shard(n)
+
+    if not multichip and not force_device and n < edb.host_crossover():
         # Same crossover as ed25519: a kernel flush below it loses to the C
         # host verifier (ops/chost does its own ristretto decodes + s<L).
         from tendermint_tpu.ops import chost
@@ -369,7 +394,7 @@ def dispatch_batch(items: list[tuple[bytes, bytes, bytes]],
             return _host_fallback(items, n)
     def _device():
         faults.fire("ops.sr25519.device")
-        return _dispatch_device(items, n)
+        return _dispatch_device(items, n, multichip)
 
     return _cbreaker.guarded_dispatch(
         BREAKER, _device, lambda: _host_fallback(items, n))

@@ -7,11 +7,24 @@ validator axis. We shard that axis across TPU devices with shard_map over a
 voting-power tally is all-reduced over ICI with psum - the on-device analogue
 of the reference's libs/bits.BitArray + talliedVotingPower loop
 (types/validator_set.go:685-714).
+
+Production routing (docs/PARALLEL.md): both kernel ops modules
+(ops/ed25519_batch, ops/sr25519_batch) ask :func:`should_shard` at dispatch
+time, so every caller of the BatchVerifier registry -- verify_commit_async,
+the fast-sync verify-ahead pipeline, the consensus vote drain, light
+range_verify -- gets multi-device sharding transparently through the deferred
+dispatch()/PendingVerify contract. Knobs:
+
+  TM_TPU_SHARD=0       opt out of sharding entirely (single-device paths)
+  TM_TPU_SHARD_MIN=N   batch-size floor for the sharded route (default
+                       n_devices * MIN_BUCKET: below one kernel bucket per
+                       device the fan-out cannot pay for itself)
+  TM_TPU_DISABLE_SHARD=1  legacy alias for TM_TPU_SHARD=0
 """
 
 from __future__ import annotations
 
-from functools import partial
+import os
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +36,38 @@ try:
     _shard_map = jax.shard_map  # jax >= 0.5
 except AttributeError:  # older jax ships it under experimental
     from jax.experimental.shard_map import shard_map as _shard_map
+
+
+# ---------------------------------------------------------------------------
+# Shard-routing policy (shared by every kernel ops module)
+# ---------------------------------------------------------------------------
+
+
+def shard_enabled() -> bool:
+    """False when the operator opted out (TM_TPU_SHARD=0, or the legacy
+    TM_TPU_DISABLE_SHARD=1 the dryrun harness has always used)."""
+    if os.environ.get("TM_TPU_SHARD") == "0":
+        return False
+    return os.environ.get("TM_TPU_DISABLE_SHARD") != "1"
+
+
+def shard_threshold(ndev: int) -> int:
+    """Batch-size floor for the sharded route. Default: one kernel MIN_BUCKET
+    per device -- smaller batches cannot fill the mesh, and the per-device
+    dispatch overhead would exceed the fan-out win."""
+    v = os.environ.get("TM_TPU_SHARD_MIN")
+    if v:
+        return int(v)
+    return ndev * ed25519_batch.MIN_BUCKET
+
+
+def should_shard(n: int) -> bool:
+    """THE routing decision both kernel dispatch_batch entry points consult:
+    >1 local device, sharding not opted out, and the batch at or above the
+    threshold. On 1 device this is always False, so every path behaves
+    exactly as the single-device build."""
+    ndev = jax.local_device_count()
+    return ndev > 1 and shard_enabled() and n >= shard_threshold(ndev)
 
 
 def make_mesh(devices=None) -> Mesh:
@@ -85,27 +130,44 @@ def _get_mesh() -> Mesh:
 
 
 def _local_verify(tab_full, idx, h_win, s_win, r_y, r_sign, valid):
-    """Per-device body: gather this shard's comb tables from the replicated
-    key-set table, then run the verify kernel. Gathering INSIDE shard_map
-    keeps the per-call H2D payload to indices + scalars; the (heavy,
-    height-persistent) tables replicate once per validator set."""
+    """Per-device ed25519 body: gather this shard's comb tables from the
+    replicated key-set table, then run the verify kernel. Gathering INSIDE
+    shard_map keeps the per-call H2D payload to indices + scalars; the
+    (heavy, height-persistent) tables replicate once per validator set."""
     tab = jnp.take(tab_full, idx, axis=0)
     return ed25519_batch._verify_kernel(
         tab, h_win, s_win, r_y, r_sign, valid, axis_name="dp")
 
 
-def _sharded_verify_fn(mesh: Mesh):
-    key = tuple(id(d) for d in mesh.devices.flat)
+def _local_verify_sr(tab_full, idx, k_win, s_win, r_limbs, valid):
+    """Per-device sr25519 body: same replicated-table gather, schnorrkel
+    kernel (ops/sr25519_batch; the challenge k stands in for h)."""
+    from tendermint_tpu.ops import sr25519_batch
+
+    tab = jnp.take(tab_full, idx, axis=0)
+    return sr25519_batch._sr_verify_kernel(
+        tab, k_win, s_win, r_limbs, valid, axis_name="dp")
+
+
+# kind -> (per-device body, number of sharded args: idx + per-item arrays).
+# The count is declared, not introspected: a later signature change (default
+# arg, decorator) must force this table to be updated in the same edit.
+_BODIES = {"ed25519": (_local_verify, 6), "sr25519": (_local_verify_sr, 5)}
+
+
+def _sharded_verify_fn(mesh: Mesh, kind: str = "ed25519"):
+    body, n_item_args = _BODIES[kind]
+    key = (kind,) + tuple(id(d) for d in mesh.devices.flat)
     fn = _fn_cache.get(key)
     if fn is None:
         fn = jax.jit(_shard_map(
-            _local_verify,
+            body,
             mesh=mesh,
-            in_specs=(P(), P("dp"), P("dp"), P("dp"), P("dp"), P("dp"), P("dp")),
+            in_specs=(P(),) + (P("dp"),) * n_item_args,
             out_specs=P("dp"),
         ))
         _fn_cache[key] = fn
-        if len(_fn_cache) > 4:
+        if len(_fn_cache) > 8:
             _fn_cache.pop(next(iter(_fn_cache)))
     return fn
 
@@ -122,40 +184,45 @@ def replicated_tables(ks, mesh: Mesh):
     return tab
 
 
-def dispatch_batch_sharded(ks, key_idx, items, pub_ok):
-    """Multi-device production dispatch: the signature axis shards over the
-    ("dp",) mesh (the north-star sentence: validator sets sharded across TPU
-    cores, pass/fail bitmap all-reduced). Dispatches in fixed
-    n_devices*JNP_TILE chunks so no batch size triggers a fresh compile.
+def _count_sharded_dispatch(ndev: int) -> None:
+    from tendermint_tpu.utils import metrics as tmmetrics
 
-    Returns the (Npad,) bool device array without fetching (callers batch
-    the readback); the bitmap is byte-identical to the single-device path."""
+    if tmmetrics.GLOBAL_NODE_METRICS is not None:
+        tmmetrics.GLOBAL_NODE_METRICS.verify_sharded.add(devices=ndev)
+
+
+def dispatch_sharded(kind: str, ks, key_idx, arrays: list, n: int):
+    """Generic multi-device production dispatch: the signature axis shards
+    over the ("dp",) mesh (the north-star sentence: validator sets sharded
+    across TPU cores, pass/fail bitmap all-reduced). Dispatches in fixed
+    n_devices*JNP_TILE chunks so no batch size triggers a fresh compile;
+    padding lanes carry valid=False (every kernel masks its result with
+    `valid`, so they can never read as accepted) and key index 0.
+
+    `arrays` is the kernel-specific per-item numpy argument list, valid
+    LAST (ed25519: h_win, s_win, r_y, r_sign, valid; sr25519: k_win, s_win,
+    r_limbs, valid). Returns the (Npad,) bool device array without fetching
+    (callers batch the readback); the bitmap is byte-identical to the
+    single-device path."""
     import numpy as np
 
     mesh = _get_mesh()
     ndev = mesh.devices.size
     tile = ed25519_batch.JNP_TILE
     chunk = ndev * tile
-    n = len(items)
-
-    s = ed25519_batch.prepare_scalars(items, pub_ok, windows=True)
-    r_y, r_sign = ed25519_batch._r_to_limbs(s["r32"])
     nb = -(-n // chunk) * chunk
 
-    def pad(v, dtype=None):
-        out = np.zeros((nb,) + v.shape[1:], dtype=dtype or v.dtype)
+    def pad(v):
+        out = np.zeros((nb,) + v.shape[1:], dtype=v.dtype)
         out[:n] = v
         return out
 
-    h_win = pad(s["h_win"].astype(np.int32))
-    s_win = pad(s["s_win"].astype(np.int32))
-    r_yp, r_sp = pad(r_y), pad(r_sign)
-    valid = pad(s["valid"])
     idx = np.zeros((nb,), dtype=np.int32)
     idx[:n] = key_idx
+    padded = [pad(np.asarray(v)) for v in arrays]
 
     tab_full = replicated_tables(ks, mesh)
-    fn = _sharded_verify_fn(mesh)
+    fn = _sharded_verify_fn(mesh, kind)
     spec = NamedSharding(mesh, P("dp"))
     outs = []
     for off in range(0, nb, chunk):
@@ -163,10 +230,19 @@ def dispatch_batch_sharded(ks, key_idx, items, pub_ok):
         outs.append(fn(
             tab_full,
             jax.device_put(idx[sl], spec),
-            jax.device_put(h_win[sl], spec),
-            jax.device_put(s_win[sl], spec),
-            jax.device_put(r_yp[sl], spec),
-            jax.device_put(r_sp[sl], spec),
-            jax.device_put(valid[sl], spec),
+            *(jax.device_put(v[sl], spec) for v in padded),
         ))
+    _count_sharded_dispatch(ndev)
     return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+
+
+def dispatch_batch_sharded(ks, key_idx, items, pub_ok):
+    """ed25519 sharded dispatch (the original production entry): host prep
+    here, then the generic chunked shard_map driver."""
+    import numpy as np
+
+    s = ed25519_batch.prepare_scalars(items, pub_ok, windows=True)
+    r_y, r_sign = ed25519_batch._r_to_limbs(s["r32"])
+    arrays = [s["h_win"].astype(np.int32), s["s_win"].astype(np.int32),
+              r_y, r_sign, s["valid"]]
+    return dispatch_sharded("ed25519", ks, key_idx, arrays, len(items))

@@ -105,8 +105,11 @@ class VoteSet:
     def add_votes(self, votes: list[Vote]) -> list[tuple[bool, Exception | None]]:
         """Deferred batched mode: one kernel flush for all signatures, then
         in-order application. Result list is parallel to `votes`."""
+        from tendermint_tpu.crypto import sigcache
+
         prechecked: list[tuple[Vote, object] | None] = []
         results: list[tuple[bool, Exception | None]] = [None] * len(votes)  # type: ignore
+        dc = sigcache.DrainCache()
         verifier = crypto_batch.create_batch_verifier()
         queued: list[int] = []
         # Gossiped votes at one (height, round, step, block) share identical
@@ -129,12 +132,23 @@ class VoteSet:
             sb = sb_memo.get(sb_key)
             if sb is None:
                 sb = sb_memo[sb_key] = vote.sign_bytes(self.chain_id)
+            # A triple already verified in an earlier drain (gossip
+            # re-delivery, another round's batch) skips the kernel and goes
+            # straight to the accept-replay below.
+            if dc.check(i, checked.pub_key.bytes(), sb, vote.signature):
+                continue
             verifier.add(checked.pub_key, sb, vote.signature)
             queued.append(i)
-        if queued:
-            _, bitmap = verifier.verify()
-            ok_by_i = dict(zip(queued, bitmap))
-            for i in queued:
+        if queued or dc.cached_ok:
+            try:
+                bitmap = verifier.verify()[1] if queued else []
+            except BaseException:
+                dc.commit([], [])  # flush metrics deltas; nothing cached
+                raise
+            ok_by_i = dc.commit(queued, bitmap)
+            # queued and the cache hits are each ascending; the merged
+            # sorted order is exactly the serial arrival order.
+            for i in sorted(ok_by_i):
                 vote, val = prechecked[i]  # type: ignore[misc]
                 if not ok_by_i[i]:
                     results[i] = (False, VoteError(

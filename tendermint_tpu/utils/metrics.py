@@ -184,6 +184,17 @@ class NodeMetrics:
         self.batch_verify_sigs = r.counter(
             "consensus", "batch_verify_sigs_total",
             "Signatures verified through the batch verifier.")
+        self.verify_sharded = r.counter(
+            "consensus", "verify_sharded_total",
+            "Batch-verify dispatches routed through the multi-device "
+            "shard_map mesh (parallel/batch_shard).", labels=("devices",))
+        self.sigcache_hits = r.counter(
+            "crypto", "sigcache_hits_total",
+            "Vote-drain signature verifications skipped via the verified-"
+            "signature cache (crypto/sigcache).")
+        self.sigcache_misses = r.counter(
+            "crypto", "sigcache_misses_total",
+            "Vote-drain signature cache misses (verification paid).")
         # state
         self.block_processing_time = r.histogram(
             "state", "block_processing_time",
@@ -227,10 +238,12 @@ class NodeMetrics:
             "ops", "breaker_trips_total",
             "Lifetime closed->open transitions of the device breaker.",
             labels=("kernel",))
-        # pre-seed the unlabeled watchdog series so a healthy node scrapes
-        # an explicit 0 instead of an absent metric
+        # pre-seed the unlabeled watchdog + sigcache series so a healthy
+        # node scrapes an explicit 0 instead of an absent metric
         self.consensus_stalled.set(0.0)
         self.watchdog_recoveries.add(0.0)
+        self.sigcache_hits.add(0.0)
+        self.sigcache_misses.add(0.0)
 
 
 # Global registry hook for hot paths that have no handle on the node (the

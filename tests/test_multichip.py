@@ -1,6 +1,9 @@
-"""Multi-chip shard path under pytest: the 8-device virtual CPU mesh from
-conftest drives the shard_map verify + psum tally (VERDICT round-2: the
-sharded path had only smoke coverage, no pytest)."""
+"""Multi-chip shard path under pytest: the multi-device virtual CPU mesh
+from conftest drives the shard_map verify + psum tally (VERDICT round-2: the
+sharded path had only smoke coverage, no pytest).
+
+The mesh is derived from whatever conftest provides (8 devices today, but
+nothing here assumes that); below 2 devices every test skips."""
 
 import jax
 import numpy as np
@@ -14,8 +17,14 @@ from tendermint_tpu.parallel import batch_shard
 @pytest.fixture(scope="module")
 def mesh():
     devices = jax.devices()
-    assert len(devices) == 8, "conftest must provide the 8-device CPU mesh"
+    if len(devices) < 2:
+        pytest.skip("multi-chip tests need >= 2 devices "
+                    "(conftest provides the virtual CPU mesh)")
     return batch_shard.make_mesh(devices)
+
+
+def _ndev(mesh):
+    return mesh.devices.size
 
 
 def _batch(n, tamper=()):
@@ -31,9 +40,19 @@ def _batch(n, tamper=()):
     return items, args
 
 
+def _tally_batch(mesh, n, tamper=()):
+    """prepare() pads to a power-of-2 bucket; the tally step needs the
+    padded axis divisible by the mesh."""
+    _, args = _batch(n, tamper=tamper)
+    if args["valid"].shape[0] % _ndev(mesh) != 0:
+        pytest.skip(f"padded bucket {args['valid'].shape[0]} not divisible "
+                    f"by {_ndev(mesh)} devices")
+    return args
+
+
 def test_sharded_verify_tally_all_valid(mesh):
     n = 64
-    _, args = _batch(n)
+    args = _tally_batch(mesh, n)
     power = np.full((args["valid"].shape[0],), 3, dtype=np.int32)
     for_block = args["valid"].copy()
     step = batch_shard.sharded_verify_tally(mesh)
@@ -43,16 +62,16 @@ def test_sharded_verify_tally_all_valid(mesh):
         placed["r_sign"], placed["valid"], placed["power"], placed["for_block"])
     ok = np.asarray(ok)
     assert ok[:n].all()
-    assert int(tally) == 3 * n  # psum across all 8 shards
+    assert int(tally) == 3 * n  # psum across all shards
     assert bool(all_ok)
     # result bitmap is actually sharded over the mesh
-    assert len(ok) % 8 == 0
+    assert len(ok) % _ndev(mesh) == 0
 
 
 def test_sharded_verify_tally_detects_bad_sigs(mesh):
     n = 64
     tampered = {5, 23, 60}
-    _, args = _batch(n, tamper=tampered)
+    args = _tally_batch(mesh, n, tamper=tampered)
     power = np.ones((args["valid"].shape[0],), dtype=np.int32)
     for_block = args["valid"].copy()
     step = batch_shard.sharded_verify_tally(mesh)
@@ -72,8 +91,8 @@ def test_production_verify_batch_routes_through_shard(mesh, monkeypatch):
     Ed25519BatchVerifier calls) must itself shard on a multi-device mesh and
     agree bit-for-bit with the single-device path (VERDICT r3: batch_shard
     was reachable only from the dryrun/tests, never from production)."""
-    n = 8 * ed25519_batch.JNP_TILE  # one full sharded chunk on 8 devices
-    tampered = {3, 500, n - 1}
+    n = _ndev(mesh) * ed25519_batch.JNP_TILE  # one full sharded chunk
+    tampered = {3, n // 2, n - 1}
     items = []
     for i in range(n):
         priv = ref.gen_priv_key(bytes([i % 61 + 1]) * 32)  # 61 unique keys
@@ -83,8 +102,41 @@ def test_production_verify_batch_routes_through_shard(mesh, monkeypatch):
             sig = sig[:-1] + bytes([sig[-1] ^ 1])
         items.append((priv.pub_key().data, msg, sig))
 
+    assert batch_shard.should_shard(n)
     sharded = ed25519_batch.verify_batch(items)
-    monkeypatch.setenv("TM_TPU_DISABLE_SHARD", "1")
+    monkeypatch.setenv("TM_TPU_SHARD", "0")
+    assert not batch_shard.should_shard(n)
+    single = ed25519_batch.verify_batch(items)
+    assert (sharded == single).all()
+    for i in range(n):
+        assert sharded[i] == (i not in tampered), i
+
+
+@pytest.mark.parametrize("extra", [1, 7])
+def test_sharded_uneven_batch_pads_and_masks(mesh, monkeypatch, extra):
+    """N not divisible by the device count: the shard driver pads the
+    signature axis up to a device multiple with valid=False lanes and key
+    index 0; the returned bitmap must have exactly N entries and be
+    bit-identical to the single-device route (padding lanes can never leak
+    in as accepted)."""
+    ndev = _ndev(mesh)
+    n = batch_shard.shard_threshold(ndev) + extra
+    if n % ndev == 0:  # a device count that divides `extra`: not uneven
+        n += 1
+    tampered = {0, n - 1}
+    items = []
+    for i in range(n):
+        priv = ref.gen_priv_key(bytes([i % 13 + 1]) * 32)
+        msg = b"uneven-%d" % i
+        sig = ref.sign(priv.data, msg)
+        if i in tampered:
+            sig = sig[:-1] + bytes([sig[-1] ^ 1])
+        items.append((priv.pub_key().data, msg, sig))
+
+    assert batch_shard.should_shard(n)
+    sharded = ed25519_batch.verify_batch(items)
+    assert sharded.shape == (n,)
+    monkeypatch.setenv("TM_TPU_SHARD", "0")
     single = ed25519_batch.verify_batch(items)
     assert (sharded == single).all()
     for i in range(n):
@@ -95,7 +147,7 @@ def test_sharded_matches_single_device(mesh):
     """The sharded decision bitmap must be byte-identical to the single-chip
     jnp kernel over the same prepared batch."""
     n = 32
-    _, args = _batch(n, tamper={7})
+    args = _tally_batch(mesh, n, tamper={7})
     single = np.asarray(ed25519_batch._jnp_kernel(
         args["tab"], args["h_win"], args["s_win"], args["r_y"],
         args["r_sign"], args["valid"]))

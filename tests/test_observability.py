@@ -67,6 +67,38 @@ def test_metrics_registry_exposition():
     assert "tm_state_apply_seconds_sum 5.55" in text
 
 
+def test_sigcache_and_sharded_verify_metrics_exposed():
+    """ISSUE 4 metrics satellite: the signature-cache hit/miss counters and
+    the sharded-dispatch counter flow through NodeMetrics into the same
+    exposition the /metrics route serves."""
+    from tendermint_tpu.crypto import sigcache
+    from tendermint_tpu.utils import metrics as tmmetrics
+
+    m = tmmetrics.NodeMetrics()
+    text = m.registry.expose()
+    # pre-seeded at 0 so a healthy node scrapes explicit zeros
+    assert "tendermint_crypto_sigcache_hits_total 0.0" in text
+    assert "tendermint_crypto_sigcache_misses_total 0.0" in text
+
+    tmmetrics.GLOBAL_NODE_METRICS = m
+    try:
+        sigcache.reset()
+        c = sigcache.get()
+        k = sigcache.cache_key(b"p", b"m", b"s")
+        c.hit(k)   # miss
+        c.add(k)
+        c.hit(k)   # hit
+        m.verify_sharded.add(devices=8)
+        text = m.registry.expose()
+        assert "tendermint_crypto_sigcache_hits_total 1.0" in text
+        assert "tendermint_crypto_sigcache_misses_total 1.0" in text
+        assert ('tendermint_consensus_verify_sharded_total{devices="8"} 1.0'
+                in text)
+    finally:
+        tmmetrics.GLOBAL_NODE_METRICS = None
+        sigcache.reset()
+
+
 def _mk_result(events=None, code=0):
     return abci.ResponseDeliverTx(code=code, data=b"ok", gas_wanted=1,
                                   events=events or [])
@@ -258,6 +290,9 @@ def test_localnet_metrics_and_tx_search(tmp_path):
         assert hval and float(hval[0].split()[-1]) >= 1
         assert "tendermint_mempool_size" in text
         assert "tendermint_state_block_processing_time_count" in text
+        # ISSUE 4: sigcache counters ride the same scrape (pre-seeded 0)
+        assert "tendermint_crypto_sigcache_hits_total" in text
+        assert "tendermint_crypto_sigcache_misses_total" in text
     finally:
         node.stop()
         from tendermint_tpu.utils import metrics as tmmetrics

@@ -153,6 +153,79 @@ def test_verify_ahead_batches_blocking_fetches(monkeypatch):
         f"depth-1's {depth1}")
 
 
+@pytest.mark.quick
+def test_sharded_registry_bitmap_matches_single_device(monkeypatch):
+    """ISSUE 4 acceptance gate, quick tier: on the multi-device CPU mesh the
+    REGISTRY-level dispatch (crypto/batch.create_batch_verifier -- the exact
+    object verify_commit_async, fast-sync, the vote drain, and range_verify
+    construct) must shard and return a bitmap identical to TM_TPU_SHARD=0
+    single-device for the same batch, valid + tampered lanes, for ed25519,
+    sr25519, and the mixed router.
+
+    Small tiles keep the one-time XLA compiles bounded on the CI host: the
+    sharded path dispatches in fixed ndev*JNP_TILE chunks, so shrinking
+    JNP_TILE shrinks the compiled chunk without changing the routing."""
+    import jax
+
+    if jax.local_device_count() < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    from tendermint_tpu.crypto import sr25519
+    from tendermint_tpu.ops import ed25519_batch as edb
+    from tendermint_tpu.parallel import batch_shard
+
+    monkeypatch.setattr(edb, "JNP_TILE", 16)
+    monkeypatch.setenv("TM_TPU_SHARD_MIN", "16")
+    monkeypatch.setenv("TM_TPU_BATCH_MIN", "1")
+
+    def ed_item(i, tamper=False):
+        p = ed25519.gen_priv_key(bytes([i % 61 + 1]) * 32)
+        m = b"gate-ed-%d" % i
+        s = p.sign(m)
+        if tamper:
+            s = s[:-1] + bytes([s[-1] ^ 1])
+        return (p.pub_key(), m, s)
+
+    def sr_item(i, tamper=False):
+        p = sr25519.gen_priv_key(bytes([i % 13 + 1]) * 32)
+        m = b"gate-sr-%d" % i
+        s = p.sign(m)
+        if tamper:
+            s = s[:-2] + bytes([s[-2] ^ 1]) + s[-1:]
+        return (p.pub_key(), m, s)
+
+    ed_items = [ed_item(i, tamper=i in (3, 20)) for i in range(44)]
+    sr_items = [sr_item(i, tamper=i == 5) for i in range(20)]
+    # Mixed: interleave so the router's order restoration is exercised.
+    mixed, want_mixed = [], []
+    for i in range(36):
+        if i % 2 == 0:
+            mixed.append(ed_item(i, tamper=i == 8))
+            want_mixed.append(i != 8)
+        else:
+            mixed.append(sr_item(i, tamper=i == 11))
+            want_mixed.append(i != 11)
+
+    def registry(key_type, items):
+        v = cbatch.create_batch_verifier(key_type)
+        for pk, m, s in items:
+            v.add(pk, m, s)
+        return v.dispatch().resolve()
+
+    cases = [("ed25519", ed_items, [i not in (3, 20) for i in range(44)]),
+             ("sr25519", sr_items, [i != 5 for i in range(20)]),
+             (None, mixed, want_mixed)]
+    for key_type, items, want in cases:
+        monkeypatch.delenv("TM_TPU_SHARD", raising=False)
+        assert batch_shard.should_shard(len(items))
+        all_ok_sh, sharded = registry(key_type, items)
+        monkeypatch.setenv("TM_TPU_SHARD", "0")
+        all_ok_si, single = registry(key_type, items)
+        monkeypatch.delenv("TM_TPU_SHARD", raising=False)
+        assert sharded == single, f"{key_type}: sharded != single-device"
+        assert sharded == want, f"{key_type}: bitmap != scalar ground truth"
+        assert all_ok_sh == all_ok_si == all(want)
+
+
 def test_range_verify_one_flush_and_no_scalar_header_hashing(monkeypatch):
     """BASELINE config 3's shape must not silently regress: the whole range
     verifies in EXACTLY one kernel flush, and header hashing goes through

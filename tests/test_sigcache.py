@@ -1,0 +1,207 @@
+"""The verified-signature cache (crypto/sigcache): gossip delivers the same
+vote from several peers, and a bounded LRU of known-good (pub, msg, sig)
+digests lets the repeat copies skip the kernel/scalar verify and go straight
+to the serial accept-replay (ISSUE 4 second prong)."""
+
+import pytest
+
+from tendermint_tpu.crypto import batch as cbatch
+from tendermint_tpu.crypto import ed25519, sigcache
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import PREVOTE_TYPE, Vote
+from tendermint_tpu.types.vote_set import VoteSet
+
+CHAIN_ID = "sigcache-chain"
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    sigcache.reset()
+    yield
+    sigcache.reset()
+
+
+def _net(n):
+    privs = [ed25519.gen_priv_key((i + 1).to_bytes(2, "big") * 16)
+             for i in range(n)]
+    vals = ValidatorSet(
+        [Validator(p.pub_key().address(), p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    return [by_addr[v.address] for v in vals.validators], vals
+
+
+def _votes(privs, vals, tamper=()):
+    bid = BlockID(hash=b"\x31" * 32,
+                  part_set_header=PartSetHeader(total=1, hash=b"\x32" * 32))
+    out = []
+    for i, p in enumerate(privs):
+        v = Vote(type=PREVOTE_TYPE, height=1, round=0, block_id=bid,
+                 timestamp=Time(1_700_002_000, 0),
+                 validator_address=vals.validators[i].address,
+                 validator_index=i)
+        sig = p.sign(v.sign_bytes(CHAIN_ID))
+        if i in tamper:
+            sig = sig[:-1] + bytes([sig[-1] ^ 1])
+        v.signature = sig
+        out.append(v)
+    return out
+
+
+class _DispatchSpy:
+    """Counts how many items each registry dispatch actually verifies."""
+
+    def __init__(self, monkeypatch):
+        self.batches: list[int] = []
+        orig = cbatch._KernelBatchVerifier.dispatch
+        spy = self
+
+        def counted(vself, force_device=False):
+            spy.batches.append(len(vself._items))
+            return orig(vself, force_device=force_device)
+
+        monkeypatch.setattr(cbatch._KernelBatchVerifier, "dispatch", counted)
+
+    @property
+    def items(self):
+        return sum(self.batches)
+
+
+def test_lru_eviction_at_cap():
+    c = sigcache.SigCache(cap=3)
+    keys = [sigcache.cache_key(b"p%d" % i, b"m", b"s") for i in range(4)]
+    for k in keys[:3]:
+        c.add(k)
+    assert c.hit(keys[0])          # refresh 0: now 1 is LRU
+    c.add(keys[3])                 # evicts 1
+    assert len(c) == 3
+    assert c.hit(keys[0]) and c.hit(keys[2]) and c.hit(keys[3])
+    assert not c.hit(keys[1])
+    assert c.hits == 4 and c.misses == 1
+
+
+def test_cache_key_framing():
+    """Length framing: shifting bytes between pub and msg must not collide."""
+    assert (sigcache.cache_key(b"ab", b"c", b"s")
+            != sigcache.cache_key(b"a", b"bc", b"s"))
+
+
+def test_hit_skips_device_dispatch(monkeypatch):
+    """The fetch-spy gate: a second delivery of the same votes (fresh
+    VoteSet, same height/round -- the gossip re-delivery shape) must verify
+    ZERO items through the registry dispatch; every triple comes from the
+    cache and goes straight to the accept-replay."""
+    privs, vals = _net(6)
+    votes = _votes(privs, vals)
+    spy = _DispatchSpy(monkeypatch)
+
+    vs1 = VoteSet(CHAIN_ID, 1, 0, PREVOTE_TYPE, vals)
+    res1 = vs1.add_votes(votes)
+    assert all(ok for ok, _ in res1)
+    first_items = spy.items
+    assert first_items == len(votes)
+
+    vs2 = VoteSet(CHAIN_ID, 1, 0, PREVOTE_TYPE, vals)
+    res2 = vs2.add_votes(votes)
+    assert all(ok for ok, _ in res2)
+    assert spy.items == first_items, (
+        "cache hit still paid a verify: second delivery dispatched "
+        f"{spy.items - first_items} items")
+    c = sigcache.get()
+    assert c is not None and c.hits == len(votes)
+
+
+def test_tampered_sig_never_caches_as_valid(monkeypatch):
+    privs, vals = _net(5)
+    votes = _votes(privs, vals, tamper={2})
+    spy = _DispatchSpy(monkeypatch)
+
+    vs1 = VoteSet(CHAIN_ID, 1, 0, PREVOTE_TYPE, vals)
+    res1 = vs1.add_votes(votes)
+    assert [ok for ok, _ in res1] == [True, True, False, True, True]
+    assert "invalid signature" in str(res1[2][1])
+
+    # Second delivery: the four good votes hit the cache; the tampered one
+    # MUST miss, re-verify, and be rejected again.
+    vs2 = VoteSet(CHAIN_ID, 1, 0, PREVOTE_TYPE, vals)
+    before = spy.items
+    res2 = vs2.add_votes(votes)
+    assert [ok for ok, _ in res2] == [True, True, False, True, True]
+    assert "invalid signature" in str(res2[2][1])
+    assert spy.items - before == 1  # only the tampered lane re-verified
+    assert len(sigcache.get()) == 4
+
+
+def test_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("TM_TPU_SIGCACHE", "0")
+    assert sigcache.get() is None
+    privs, vals = _net(3)
+    votes = _votes(privs, vals)
+    spy = _DispatchSpy(monkeypatch)
+    for _ in range(2):
+        vs = VoteSet(CHAIN_ID, 1, 0, PREVOTE_TYPE, vals)
+        assert all(ok for ok, _ in vs.add_votes(votes))
+    assert spy.items == 2 * len(votes)  # both deliveries paid full verify
+
+
+def test_cap_env_knob(monkeypatch):
+    monkeypatch.setenv("TM_TPU_SIGCACHE_CAP", "2")
+    privs, vals = _net(5)
+    votes = _votes(privs, vals)
+    vs = VoteSet(CHAIN_ID, 1, 0, PREVOTE_TYPE, vals)
+    assert all(ok for ok, _ in vs.add_votes(votes))
+    assert len(sigcache.get()) == 2  # LRU held at the cap
+
+
+def test_device_fault_does_not_poison_cache(monkeypatch):
+    """TMTPU_FAULTS device-failure interaction: with the ed25519 device
+    route raising, the breaker degrades the flush to the host fallback
+    WITHIN the same dispatch -- the resolved bitmap is still correct, so
+    good votes may cache, but the tampered lane must stay uncached and
+    rejected. A flush whose resolve RAISES outright caches nothing."""
+    from tendermint_tpu.ops import ed25519_batch as edb
+    from tendermint_tpu.utils import faults
+
+    privs, vals = _net(4)
+    votes = _votes(privs, vals, tamper={1})
+    # Pin the kernel route (no host crossover absorb) so the injected
+    # device fault actually fires, and drop batch_min so 4 votes dispatch.
+    monkeypatch.setenv("TM_TPU_HOST_CROSSOVER", "0")
+    monkeypatch.setenv("TM_TPU_BATCH_MIN", "1")
+    faults.configure(["ops.ed25519.device:raise"], seed=7)
+    edb.BREAKER.reset()
+    try:
+        vs = VoteSet(CHAIN_ID, 1, 0, PREVOTE_TYPE, vals)
+        res = vs.add_votes(votes)
+        assert [ok for ok, _ in res] == [True, False, True, True]
+        assert edb.BREAKER.failures >= 1  # the fault really fired
+        c = sigcache.get()
+        assert len(c) == 3  # only the host-reverified good lanes
+        bad = votes[1]
+        ck = sigcache.cache_key(
+            vals.validators[1].pub_key.bytes(),
+            bad.sign_bytes(CHAIN_ID), bad.signature)
+        assert not c.hit(ck)
+    finally:
+        faults.clear()
+        edb.BREAKER.reset()
+
+    # Resolve-raises-outright: nothing may enter the cache.
+    sigcache.reset()
+
+    def broken_dispatch(vself, force_device=False):
+        vself._items = []
+
+        def boom(_fetched):
+            raise RuntimeError("device died at fetch")
+
+        return cbatch.PendingVerify([object()], boom)
+
+    monkeypatch.setattr(cbatch._KernelBatchVerifier, "dispatch",
+                        broken_dispatch)
+    vs = VoteSet(CHAIN_ID, 1, 0, PREVOTE_TYPE, vals)
+    with pytest.raises(RuntimeError):
+        vs.add_votes(votes)
+    assert len(sigcache.get()) == 0
