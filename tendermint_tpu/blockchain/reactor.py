@@ -293,11 +293,17 @@ class BlockchainReactor(Reactor):
 
     def _punish_invalid(self, height: int, e: Exception) -> None:
         """Punish BOTH senders: the bad LastCommit is carried by the
-        second block (reference: blockchain/v0/reactor.go:394-408)."""
+        second block (reference: blockchain/v0/reactor.go:394-408).
+        Scored as well as disconnected (docs/OVERLOAD.md) — a fast-sync
+        peer feeding invalid blocks in a redial loop must escalate to a
+        ban, not recycle free disconnects."""
         bad = self.pool.redo_request(height)
         bad2 = self.pool.redo_request(height + 1)
         if self.switch is not None:
+            board = getattr(self.switch, "scoreboard", None)
             for pid in {bad, bad2} - {None}:
+                if board is not None:
+                    board.record(pid, "bad_message")
                 if pid in self.switch.peers:
                     self.switch.stop_peer_for_error(
                         self.switch.peers[pid], f"invalid block: {e}")

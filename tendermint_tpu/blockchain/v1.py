@@ -269,7 +269,10 @@ class BlockchainReactorV1(Reactor):
         path redoes first.Height and first.Height+1)."""
         bad = self.pool.redo_request(height)
         bad2 = self.pool.redo_request(height + 1)
+        board = getattr(self.switch, "scoreboard", None)
         for pid in {bad, bad2} - {None}:
+            if board is not None:
+                board.record(pid, "bad_message")  # escalates on redial loops
             self.drop_peer(pid, f"invalid block: {e}")
 
     def on_finished(self) -> None:

@@ -50,6 +50,10 @@ class RPCConfig:
     max_body_bytes: int = 1000000
     max_header_bytes: int = 1 << 20
     pprof_laddr: str = ""
+    # broadcast_tx_* admission gate (docs/OVERLOAD.md): concurrent
+    # CheckTx-holding requests beyond this get a typed overload error
+    # instead of queuing unboundedly on the mempool lock. 0 disables.
+    max_broadcast_tx_inflight: int = 256
 
 
 @dataclass
@@ -77,6 +81,17 @@ class P2PConfig:
     allow_duplicate_ip: bool = False
     handshake_timeout_s: float = 20.0
     dial_timeout_s: float = 3.0
+    # Overload-resilience plane (utils/peerscore.py, docs/OVERLOAD.md):
+    # decaying per-peer misbehavior scores with escalating sanctions.
+    peer_score_halflife_s: float = 120.0   # score decay half-life
+    peer_disconnect_score: float = 50.0    # crossing => disconnect (0 = off)
+    peer_ban_score: float = 100.0          # crossing => timed ban (0 = off)
+    peer_ban_duration_s: float = 30.0      # first ban; doubles per re-offense
+    peer_ban_max_duration_s: float = 600.0
+    # Per-peer per-channel inbound message ceilings, msgs/s token buckets
+    # ("<ch>:<rate>,..." e.g. "0x22:2000,0x30:4000,0x61:200"; empty = off).
+    # Over-limit deliveries are scored and dropped, never processed.
+    recv_msg_rate: str = ""
 
 
 @dataclass

@@ -46,9 +46,11 @@ from tendermint_tpu.types.vote import (
     PRECOMMIT_TYPE,
     PREVOTE_TYPE,
     ErrVoteConflictingVotes,
+    ErrVoteInvalidSignature,
     Vote,
 )
 from tendermint_tpu.types.vote_set import VoteSet
+from tendermint_tpu.utils import peerscore
 from tendermint_tpu.utils import trace as _trace
 
 
@@ -179,7 +181,13 @@ class ConsensusState:
         self.rs = cstypes.RoundState()
         self.state = None  # sm.State; set by update_to_state
 
-        self._msg_queue: queue.Queue = queue.Queue(maxsize=1000)
+        # Peer gossip enters through a priority shed queue (docs/OVERLOAD.md):
+        # at capacity, stale-height gossip sheds first and live-height votes
+        # survive, and gossip threads NEVER block on a saturated consensus
+        # consumer. Internal messages (own votes/proposals) keep a plain
+        # bounded queue — they are never shed.
+        self._msg_queue = peerscore.ShedQueue(maxsize=1000,
+                                              on_shed=self._count_shed)
         self._internal_queue: queue.Queue = queue.Queue(maxsize=1000)
         self._ticker = TimeoutTicker(self._on_timeout_fired)
         self._timeout_queue: queue.Queue = queue.Queue()
@@ -195,6 +203,12 @@ class ConsensusState:
         self._running = False
         self.replay_mode = False
         self._n_steps = 0
+        # Peer misbehavior scoreboard (utils/peerscore.py), set by node
+        # wiring to the switch's board: invalid-signature lanes out of the
+        # batched vote-drain bitmap (and the serial VoteError path) are
+        # attributed to the delivering peer. None = scoring disabled
+        # (standalone/replay machines).
+        self.scoreboard = None
         # Maverick-style misbehavior hooks for adversarial testing
         # (reference: test/maverick/consensus/misbehavior.go:16). Key
         # "prevote" -> fn(cs, height, round) replaces the default prevote
@@ -298,18 +312,48 @@ class ConsensusState:
 
     # --- external input (reference: consensus/state.go:430-520) ------------
 
+    def _gossip_priority(self, height: int) -> int:
+        """Shed class for a peer gossip message: live-height messages
+        survive overload, stale-height gossip (re-derivable from stores
+        and gossip re-delivery) sheds first. The unlocked rs.height read
+        only biases shedding, never correctness."""
+        rs_h = self.rs.height
+        if height == rs_h:
+            return peerscore.PRIO_LIVE
+        if height > rs_h:
+            return peerscore.PRIO_FUTURE
+        return peerscore.PRIO_STALE
+
+    def _count_shed(self, channel: str) -> None:
+        board = self.scoreboard
+        if board is not None:
+            board.count_shed(channel)
+
     def add_vote(self, vote: Vote, peer_id: str = "") -> None:
-        q = self._internal_queue if peer_id == "" else self._msg_queue
-        q.put(MsgInfo(VoteMessage(vote), peer_id))
+        if peer_id == "":
+            self._internal_queue.put(MsgInfo(VoteMessage(vote), peer_id))
+        else:
+            self._msg_queue.put(MsgInfo(VoteMessage(vote), peer_id),
+                                priority=self._gossip_priority(vote.height),
+                                channel="vote")
 
     def set_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
-        q = self._internal_queue if peer_id == "" else self._msg_queue
-        q.put(MsgInfo(ProposalMessage(proposal), peer_id))
+        if peer_id == "":
+            self._internal_queue.put(MsgInfo(ProposalMessage(proposal), peer_id))
+        else:
+            self._msg_queue.put(MsgInfo(ProposalMessage(proposal), peer_id),
+                                priority=self._gossip_priority(proposal.height),
+                                channel="proposal")
 
     def add_proposal_block_part(self, height: int, round_: int, part: Part,
                                 peer_id: str = "") -> None:
-        q = self._internal_queue if peer_id == "" else self._msg_queue
-        q.put(MsgInfo(BlockPartMessage(height, round_, part), peer_id))
+        if peer_id == "":
+            self._internal_queue.put(
+                MsgInfo(BlockPartMessage(height, round_, part), peer_id))
+        else:
+            self._msg_queue.put(
+                MsgInfo(BlockPartMessage(height, round_, part), peer_id),
+                priority=self._gossip_priority(height), channel="block_part")
 
     def handle_txs_available(self) -> None:
         self._msg_queue.put(("__txs_available__", None))
@@ -552,7 +596,11 @@ class ConsensusState:
             ok = ok_by_i.get(i)
             if ok is False:
                 # Same terminal state as the serial path's VoteError: vote
-                # dropped, error logged, consensus thread lives on.
+                # dropped, error logged, consensus thread lives on — but
+                # the lane's FAILED bit is attributed to the delivering
+                # peer: MsgInfo.peer_id traveled the whole drain, so the
+                # batched bitmap sanctions exactly like serial verification
+                self._punish_peer(m.peer_id)
                 if self.logger is not None:
                     self.logger.error(
                         "failed to process message", err="invalid signature",
@@ -561,9 +609,17 @@ class ConsensusState:
             try:
                 self._try_add_vote(m.msg.vote, m.peer_id, verified=bool(ok))
             except Exception as e:  # noqa: BLE001 - mirror _handle_msg
+                if isinstance(e, ErrVoteInvalidSignature):
+                    self._punish_peer(m.peer_id)
                 if self.logger is not None:
                     self.logger.error("failed to process message", err=e,
                                       peer=m.peer_id)
+
+    def _punish_peer(self, peer_id: str,
+                     offense: str = "invalid_signature") -> None:
+        board = self.scoreboard
+        if board is not None and peer_id:
+            board.record(peer_id, offense)
 
     def _on_timeout_fired(self, ti: TimeoutInfo) -> None:
         # hop onto the consensus thread; WAL write happens at dequeue
@@ -585,6 +641,8 @@ class ConsensusState:
             # The reference logs and continues (consensus/state.go:880-890):
             # a bad peer message (invalid sig, wrong index, unwanted round...)
             # must never kill the consensus thread.
+            if isinstance(e, ErrVoteInvalidSignature):
+                self._punish_peer(peer_id)  # serial twin of the drain bitmap
             if self.logger is not None:
                 self.logger.error("failed to process message", err=e, peer=peer_id)
 

@@ -10,7 +10,13 @@ import threading
 import time
 
 from tendermint_tpu.encoding import proto
-from tendermint_tpu.mempool.mempool import ErrTxInCache, Mempool, MempoolError
+from tendermint_tpu.mempool.mempool import (
+    ErrMempoolIsFull,
+    ErrTxInCache,
+    ErrTxTooLarge,
+    Mempool,
+    MempoolError,
+)
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.switch import Peer, Reactor
 
@@ -51,11 +57,33 @@ class MempoolReactor(Reactor):
         inner = proto.fields(f[1][-1])
         for tx in inner.get(1, []):
             try:
-                self.mempool.check_tx(tx, sender_peer=peer.id)
+                res = self.mempool.check_tx(tx, sender_peer=peer.id)
             except ErrTxInCache:
-                pass
+                pass  # gossip re-delivery: expected, never scored
+            except ErrTxTooLarge:
+                self._score(peer, "tx_too_large")
+            except ErrMempoolIsFull:
+                # full-pool rejects score LIGHTLY: an honest peer gossiping
+                # into a saturated node is normal, a flood of these from
+                # one peer is not (docs/OVERLOAD.md)
+                self._score(peer, "mempool_full")
             except MempoolError:
+                self._score(peer, "checktx_reject")
+            except Exception:  # noqa: BLE001
+                # an unexpected app/post-check blow-up must never kill the
+                # recv thread — and it is OUR failure, not the peer's:
+                # scoring it would ban every honest gossiper during an
+                # ABCI app outage
                 pass
+            else:
+                if not res.is_ok():
+                    self._score(peer, "checktx_reject")
+
+    def _score(self, peer: Peer, offense: str) -> None:
+        sw = self.switch
+        board = getattr(sw, "scoreboard", None) if sw is not None else None
+        if board is not None:
+            board.record(peer.id, offense)
 
     def _gossip_routine(self, peer: Peer) -> None:
         """One-tx-at-a-time walk (reference: mempool/v0/reactor.go

@@ -170,11 +170,22 @@ class Node:
         self.transport = Transport(self.node_key, node_info,
                                    config.p2p.handshake_timeout_s,
                                    config.p2p.dial_timeout_s)
+        # overload-resilience plane (utils/peerscore.py, docs/OVERLOAD.md):
+        # per-node scoreboard + per-peer per-channel ingress ceilings
+        from tendermint_tpu.utils import peerscore
+
+        scoreboard = peerscore.PeerScoreBoard(
+            peerscore.ScoreConfig.from_p2p_config(config.p2p), logger=logger)
         self.switch = Switch(self.transport, logger=logger,
                              max_inbound=config.p2p.max_num_inbound_peers,
                              max_outbound=config.p2p.max_num_outbound_peers,
                              send_rate=config.p2p.send_rate,
-                             recv_rate=config.p2p.recv_rate)
+                             recv_rate=config.p2p.recv_rate,
+                             scoreboard=scoreboard,
+                             msg_rates=peerscore.parse_rate_spec(
+                                 config.p2p.recv_msg_rate))
+        # drain-bitmap invalid-signature attribution feeds the same board
+        self.consensus.scoreboard = scoreboard
 
         # state sync runs only on a fresh node (reference: node.go:991
         # startStateSync is gated on state.LastBlockHeight == 0)
@@ -205,6 +216,8 @@ class Node:
                 chunk_request_timeout_s=config.statesync.chunk_request_timeout_s,
                 chunk_fetchers=config.statesync.chunk_fetchers,
                 logger=logger)
+            # app reject_senders verdicts score the sending peer
+            syncer.scoreboard = self.switch.scoreboard
         # Reactor is registered unconditionally: every node SERVES snapshots
         # from its app (reference: node.go:839 statesync.NewReactor).
         self.statesync_reactor = StateSyncReactor(self.proxy_app.snapshot, syncer)
@@ -277,6 +290,10 @@ class Node:
                 seeds=config.p2p.seeds.split(",") if config.p2p.seeds else [],
                 logger=logger)
             self.switch.add_reactor("PEX", self.pex_reactor)
+            # a ban evicts the peer from the address book too: PEX must
+            # not keep recommending (or redialing) a sanctioned identity
+            self.switch.scoreboard.on_ban.append(
+                lambda pid, until: self.addr_book.mark_bad(pid))
 
         self.rpc_server = None
         self._tx_notify_thread = None
@@ -431,6 +448,22 @@ class Node:
         last_site_hits: dict = {}
         last_fired: dict = {}
         last_nemesis_fired: dict = {}
+        last_bans = 0
+        last_shed: dict = {}
+        last_rate_limited: dict = {}
+        last_score_peers: set = set()
+        # Counter series are permanent once created; cap the per-peer
+        # label space so identity-minting churn cannot grow /metrics
+        # without bound (overflow aggregates under peer="_overflow")
+        rl_label_cap = 1024
+        rl_labels_seen: set = set()
+
+        def _rl_labels(k):
+            peer = k[0][:16]
+            if peer in rl_labels_seen or len(rl_labels_seen) < rl_label_cap:
+                rl_labels_seen.add(peer)
+                return {"peer": peer, "channel": k[1]}
+            return {"peer": "_overflow", "channel": k[1]}
 
         def _pump_counter(counter, now_counts, last_counts, label_fn):
             for key, n in now_counts.items():
@@ -470,6 +503,26 @@ class Node:
                 _, nem_fired = _nemesis.PLANE.snapshot()
                 _pump_counter(m.nemesis_fired, nem_fired, last_nemesis_fired,
                               lambda k: {"site": k[0], "action": k[1]})
+                # overload-resilience plane: scores as live gauges, bans/
+                # sheds/rate-limits as counter deltas (one board per node)
+                board = self.switch.scoreboard.snapshot()
+                score_peers = {pid[:16] for pid in board["scores"]}
+                for pid, s in board["scores"].items():
+                    m.peer_score.set(s, peer=pid[:16])
+                for pid in last_score_peers - score_peers:
+                    # banned/decayed-away peers: drop the series — a
+                    # frozen pre-ban value misleads dashboards, and a
+                    # zeroed-but-kept line per identity ever seen would
+                    # grow /metrics cardinality without bound
+                    m.peer_score.remove(peer=pid)
+                last_score_peers = score_peers
+                if board["bans_total"] > last_bans:
+                    m.peers_banned.add(board["bans_total"] - last_bans)
+                    last_bans = board["bans_total"]
+                _pump_counter(m.shed, board["shed"], last_shed,
+                              lambda ch: {"channel": ch})
+                _pump_counter(m.rate_limited, board["rate_limited"],
+                              last_rate_limited, _rl_labels)
                 # device breaker state: only meaningful once a kernel
                 # module is loaded; never force the import from a sampler
                 for kernel in ("ed25519", "sr25519"):

@@ -36,6 +36,12 @@ class MConnectionError(Exception):
     pass
 
 
+class MConnectionProtocolError(MConnectionError):
+    """The PEER violated the wire protocol (oversized packet/message, bad
+    framing, unknown channel) — scoreable misbehavior, unlike a plain
+    MConnectionError (socket EOF/teardown), which is just the network."""
+
+
 @dataclass
 class ChannelDescriptor:
     """reference: p2p/conn/connection.go:560-600."""
@@ -78,7 +84,9 @@ class MConnection:
     def __init__(self, conn, channels: list[ChannelDescriptor], on_receive,
                  on_error=None, send_rate: int = DEFAULT_SEND_RATE,
                  recv_rate: int = DEFAULT_RECV_RATE,
-                 local_id: str = "", remote_id: str = ""):
+                 local_id: str = "", remote_id: str = "",
+                 msg_rates: dict[int, float] | None = None,
+                 on_rate_limited=None):
         self._conn = conn
         # peer-id context for the link-scoped fault plane (utils/nemesis.py):
         # which directed link this connection is, so a partition can cut
@@ -101,6 +109,16 @@ class MConnection:
         self.recv_monitor = Monitor()
         self._send_rate = send_rate
         self._recv_rate = recv_rate
+        # Per-peer per-channel inbound message ceilings (msgs/s token
+        # buckets, docs/OVERLOAD.md): over-limit deliveries are reported
+        # to on_rate_limited(ch_id) — scored by the switch — instead of
+        # being processed.
+        self._rate_limiter = None
+        if msg_rates:
+            from tendermint_tpu.utils.peerscore import ChannelRateLimiter
+
+            self._rate_limiter = ChannelRateLimiter(msg_rates)
+        self._on_rate_limited = on_rate_limited
 
     def start(self) -> None:
         self._running = True
@@ -147,6 +165,18 @@ class MConnection:
                 ch.send_queue.put(msg, block=False)
             except queue.Full:
                 pass  # duplication is best-effort; the original made it in
+        elif verdict == "flood":
+            # byzantine amplification (nemesis flood action): seeded
+            # corrupted copies ride along with the real message — invalid
+            # signatures / unparseable junk the RECEIVER must score away
+            from tendermint_tpu.utils import nemesis
+
+            for junk in nemesis.PLANE.flood_payloads(
+                    self._local_id, self._remote_id, ch_id, msg):
+                try:
+                    ch.send_queue.put(junk, block=False)
+                except queue.Full:
+                    break  # amplification is best-effort
         self._send_event.set()
         return True
 
@@ -217,14 +247,21 @@ class MConnection:
                 break
             shift += 7
             if shift > 35:
-                raise MConnectionError("bad packet length varint")
+                raise MConnectionProtocolError("bad packet length varint")
         if ln > MAX_MSG_SIZE:
-            raise MConnectionError(f"packet too big: {ln}")
+            raise MConnectionProtocolError(f"packet too big: {ln}")
         return self._read_bytes(ln)
 
     def _read_bytes(self, n: int) -> bytes:
         while len(self._recv_stream) < n:
-            chunk = self._conn.read(65536)
+            # Rate limit before pulling bytes off the wire, symmetrical to
+            # the send side (reference: connection.go recvRoutine ->
+            # recvMonitor.Limit(maxMsgPacketTotalSize, RecvRate, true)):
+            # a flooding sender backs up into ITS socket buffer instead of
+            # monopolizing our reactor threads. Blocking limit() returns
+            # at least 1 allowed byte.
+            want = self.recv_monitor.limit(65536, self._recv_rate, block=True)
+            chunk = self._conn.read(max(want, 1))
             if not chunk:
                 raise MConnectionError("connection closed")
             self._recv_stream += chunk
@@ -249,13 +286,22 @@ class MConnection:
                     data = pf.get(3, [b""])[-1]
                     ch = self._channels.get(ch_id)
                     if ch is None:
-                        raise MConnectionError(f"unknown channel {ch_id:#x}")
+                        raise MConnectionProtocolError(f"unknown channel {ch_id:#x}")
                     ch.recving += data
                     if len(ch.recving) > ch.desc.recv_message_capacity:
-                        raise MConnectionError("received message exceeds capacity")
+                        raise MConnectionProtocolError("received message exceeds capacity")
                     if eof:
                         msg = bytes(ch.recving)
                         ch.recving = bytearray()
+                        # per-channel message ceiling: an over-limit
+                        # delivery is scored (via the switch callback),
+                        # never processed — the channel's token bucket is
+                        # the SEDA admission gate in front of the reactors
+                        if (self._rate_limiter is not None
+                                and not self._rate_limiter.allow(ch_id)):
+                            if self._on_rate_limited is not None:
+                                self._on_rate_limited(ch_id)
+                            continue
                         # drop skips delivery; dup delivers twice;
                         # disconnect raises into _die, which tears the
                         # peer down like a transport error

@@ -17,8 +17,12 @@ import time
 from typing import TYPE_CHECKING
 
 from tendermint_tpu.encoding import proto
-from tendermint_tpu.utils import faults
-from tendermint_tpu.p2p.connection import ChannelDescriptor, MConnection
+from tendermint_tpu.utils import faults, peerscore
+from tendermint_tpu.p2p.connection import (
+    ChannelDescriptor,
+    MConnection,
+    MConnectionProtocolError,
+)
 from tendermint_tpu.p2p.key import NodeKey
 from tendermint_tpu.p2p.node_info import NodeInfo
 
@@ -63,7 +67,9 @@ class Peer:
                  channels: list[ChannelDescriptor], on_receive, on_error,
                  outbound: bool, persistent: bool = False,
                  socket_addr: str = "", send_rate: int = 5_120_000,
-                 recv_rate: int = 5_120_000, local_id: str = ""):
+                 recv_rate: int = 5_120_000, local_id: str = "",
+                 msg_rates: dict[int, float] | None = None,
+                 on_rate_limited=None):
         self.node_info = node_info
         self.outbound = outbound
         self.persistent = persistent
@@ -75,6 +81,9 @@ class Peer:
             on_error=lambda err: on_error(self, err),
             send_rate=send_rate, recv_rate=recv_rate,
             local_id=local_id, remote_id=node_info.node_id,
+            msg_rates=msg_rates,
+            on_rate_limited=(lambda ch: on_rate_limited(self, ch))
+            if on_rate_limited is not None else None,
         )
 
     @property
@@ -113,6 +122,12 @@ class Transport:
         self.handshake_timeout_s = handshake_timeout_s
         self.dial_timeout_s = dial_timeout_s
         self._listener: socket.socket | None = None
+        # overload-resilience hooks (set by the owning Switch): a banned
+        # peer is refused right after the handshake identifies it, on the
+        # accept AND dial sides alike; an evil handshake (claimed id not
+        # matching the authenticated key) is scored before the teardown
+        self.ban_checker = None        # fn(node_id) -> bool
+        self.on_evil_handshake = None  # fn(authenticated_node_id)
 
     def listen(self, addr: str) -> str:
         host, port = _split_addr(addr)
@@ -162,9 +177,15 @@ class Transport:
         # The authenticated ed25519 key must match the claimed node ID.
         derived = conn.remote_pub_key.address().hex()
         if derived != peer_info.node_id:
+            if self.on_evil_handshake is not None:
+                # score the AUTHENTICATED identity: the claimed one is
+                # whatever the liar chose to type
+                self.on_evil_handshake(derived)
             raise P2PError(
                 f"peer ID mismatch: claimed {peer_info.node_id}, authenticated {derived}"
             )
+        if self.ban_checker is not None and self.ban_checker(peer_info.node_id):
+            raise P2PError(f"peer {peer_info.node_id[:12]} is banned")
         raw.settimeout(None)
         return conn, peer_info, addr
 
@@ -199,10 +220,24 @@ class Switch:
 
     def __init__(self, transport: Transport, logger=None,
                  max_inbound: int = 40, max_outbound: int = 10,
-                 send_rate: int = 5_120_000, recv_rate: int = 5_120_000):
+                 send_rate: int = 5_120_000, recv_rate: int = 5_120_000,
+                 scoreboard: peerscore.PeerScoreBoard | None = None,
+                 msg_rates: dict[int, float] | None = None):
         self.send_rate = send_rate
         self.recv_rate = recv_rate
         self.transport = transport
+        # Overload-resilience plane (docs/OVERLOAD.md): one scoreboard per
+        # switch — in-process mesh nodes must sanction independently. The
+        # board decides sanctions; this switch enforces them (disconnect,
+        # ban = teardown + dial/accept refusal until expiry).
+        self.scoreboard = (scoreboard if scoreboard is not None
+                           else peerscore.PeerScoreBoard(logger=logger))
+        self.scoreboard.on_ban.append(self._on_peer_banned)
+        self.scoreboard.on_disconnect.append(self._on_peer_sanctioned)
+        self.msg_rates = dict(msg_rates) if msg_rates else {}
+        transport.ban_checker = self.scoreboard.is_banned
+        transport.on_evil_handshake = (
+            lambda nid: self.scoreboard.record(nid, "evil_handshake"))
         self.reactors: dict[str, Reactor] = {}
         self._channels: list[ChannelDescriptor] = []
         self._reactors_by_ch: dict[int, Reactor] = {}
@@ -271,6 +306,14 @@ class Switch:
     # --- dialing / accepting -----------------------------------------------
 
     def dial_peer(self, addr: str, persistent: bool = False) -> Peer | None:
+        node_id = addr.split("@", 1)[0] if "@" in addr else ""
+        if node_id and self.scoreboard.is_banned(node_id):
+            # refuse BEFORE the socket opens: a banned peer's redial must
+            # cost us nothing (the transport-side ban_checker still covers
+            # addresses dialed without an id prefix)
+            if self.logger:
+                self.logger.info("refusing dial to banned peer", addr=addr)
+            return None
         try:
             conn, peer_info, sock_addr = self.transport.dial(addr)
             return self._add_peer(conn, peer_info, outbound=True,
@@ -328,6 +371,12 @@ class Switch:
         now = time.monotonic()
         for addr in list(self._persistent_addrs):
             node_id = addr.split("@")[0] if "@" in addr else None
+            if node_id and self.scoreboard.is_banned(node_id):
+                # don't burn backoff schedule on a banned persistent peer;
+                # when the ban expires the address is retried immediately
+                attempts.pop(addr, None)
+                next_try.pop(addr, None)
+                continue
             have = node_id in self.peers if node_id else any(
                 p.socket_addr.endswith(addr) for p in self.peers.values()
             )
@@ -354,6 +403,12 @@ class Switch:
         if peer_info.node_id == self.transport.node_info.node_id:
             conn.close()
             raise P2PError("connected to self")
+        if self.scoreboard.is_banned(peer_info.node_id):
+            # inbound rejection + the in-process mesh seam: however the
+            # connection reached us (accept loop, test socketpair), a
+            # banned identity never becomes a Peer
+            conn.close()
+            raise P2PError(f"peer {peer_info.node_id[:12]} is banned")
         with self._peers_mtx:
             if peer_info.node_id in self.peers:
                 conn.close()
@@ -361,7 +416,9 @@ class Switch:
             peer = Peer(conn, peer_info, self._channels, self._on_receive,
                         self._on_peer_error, outbound, persistent, socket_addr,
                         send_rate=self.send_rate, recv_rate=self.recv_rate,
-                        local_id=self.transport.node_info.node_id)
+                        local_id=self.transport.node_info.node_id,
+                        msg_rates=self.msg_rates,
+                        on_rate_limited=self._on_rate_limited)
             self.peers[peer.id] = peer
         # Reactors attach their per-peer state (and queue their hello
         # messages) BEFORE the connection starts reading: bytes the remote
@@ -377,17 +434,52 @@ class Switch:
     # --- peer events -------------------------------------------------------
 
     def _on_receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        if self.scoreboard.is_banned(peer.id):
+            # post-ban traffic never reaches a reactor (the drain must not
+            # process a banned peer's in-flight backlog); tear down in case
+            # the ban callback raced the delivery
+            self.stop_peer_for_error(peer, "peer is banned")
+            return
         reactor = self._reactors_by_ch.get(ch_id)
         if reactor is None:
+            self.scoreboard.record(peer.id, "bad_message")
             self.stop_peer_for_error(peer, f"unknown channel {ch_id:#x}")
             return
         try:
             reactor.receive(ch_id, peer, msg_bytes)
         except Exception as e:  # noqa: BLE001
+            # Codec-shaped failures (ValueError from proto parsing /
+            # unmarshal validation) are the PEER's malformed payload:
+            # score them so a redial-and-repeat loop escalates to a ban
+            # instead of free disconnect cycles. Anything else —
+            # KeyError/IndexError included, the classic shapes of a
+            # node-local reactor bug on valid input — tears the peer
+            # down (the pre-existing contract) without scoring: our own
+            # bug must not progressively ban the honest peer set.
+            if isinstance(e, ValueError):
+                self.scoreboard.record(peer.id, "bad_message")
             self.stop_peer_for_error(peer, e)
 
     def _on_peer_error(self, peer: Peer, err) -> None:
+        if isinstance(err, MConnectionProtocolError):
+            # framing/capacity violations (oversized message, bad varint,
+            # unknown mconnection channel) are the peer's doing; a plain
+            # MConnectionError (socket EOF) is just the network — scoring
+            # it would ban honest peers across partition/reconnect churn
+            self.scoreboard.record(peer.id, "oversized_message")
         self.stop_peer_for_error(peer, err)
+
+    def _on_rate_limited(self, peer: Peer, ch_id: int) -> None:
+        """An over-limit delivery was discarded by the connection's token
+        bucket: count + score it (enough of these escalate to a ban)."""
+        self.scoreboard.count_rate_limited(peer.id, f"{ch_id:#x}")
+        self.scoreboard.record(peer.id, "rate_limited")
+
+    def _on_peer_banned(self, peer_id: str, until: float) -> None:
+        self.stop_peer_by_id(peer_id, "banned for misbehavior")
+
+    def _on_peer_sanctioned(self, peer_id: str, reason: str) -> None:
+        self.stop_peer_by_id(peer_id, reason)
 
     def stop_peer_by_id(self, peer_id: str, reason) -> bool:
         """Public stop-by-id for behaviour reporters etc.; returns False when

@@ -6,6 +6,7 @@ response types (amino-style JSON: hex upper-case hashes, stringified ints).
 from __future__ import annotations
 
 import base64
+import threading
 import time as _time
 
 from tendermint_tpu.abci import types as abci
@@ -19,6 +20,62 @@ class Environment:
     def __init__(self, node):
         self.node = node
         self.event_bus = node.event_bus
+
+
+class ErrOverloaded(Exception):
+    """Typed overload verdict from the broadcast_tx admission gate
+    (docs/OVERLOAD.md): the node is shedding RPC tx load instead of
+    queuing it unboundedly. Clients should back off and retry."""
+
+
+class _TxAdmissionGate:
+    """Max-inflight admission for broadcast_tx_* (no reference analogue —
+    the reference lets handler goroutines pile up on the mempool lock).
+    One per node; a slot is held for the duration of the CheckTx, the
+    part that contends on the mempool + ABCI connection."""
+
+    def __init__(self, limit: int):
+        self.limit = limit
+        self._inflight = 0
+        self._mtx = threading.Lock()
+
+    def acquire(self, board=None) -> None:
+        if self.limit <= 0:
+            return
+        with self._mtx:
+            if self._inflight >= self.limit:
+                if board is not None:
+                    board.count_shed("rpc_tx")
+                raise ErrOverloaded(
+                    f"node overloaded: {self._inflight} broadcast_tx "
+                    f"requests in flight (limit {self.limit}); retry later")
+            self._inflight += 1
+
+    def release(self) -> None:
+        if self.limit <= 0:
+            return
+        with self._mtx:
+            self._inflight = max(0, self._inflight - 1)
+
+
+_GATE_CREATE_MTX = threading.Lock()
+
+
+def _tx_gate(env) -> _TxAdmissionGate:
+    gate = getattr(env.node, "_rpc_tx_gate", None)
+    if gate is None:
+        with _GATE_CREATE_MTX:
+            gate = getattr(env.node, "_rpc_tx_gate", None)
+            if gate is None:
+                cfg = getattr(getattr(env.node, "config", None), "rpc", None)
+                limit = getattr(cfg, "max_broadcast_tx_inflight", 0) if cfg else 0
+                gate = _TxAdmissionGate(limit)
+                env.node._rpc_tx_gate = gate
+    return gate
+
+
+def _node_scoreboard(env):
+    return getattr(getattr(env.node, "switch", None), "scoreboard", None)
 
 
 def _b64(b: bytes) -> str:
@@ -425,21 +482,35 @@ def _decode_tx_param(tx) -> bytes:
 
 def broadcast_tx_async(env, tx):
     raw = _decode_tx_param(tx)
-    import threading
-
-    threading.Thread(target=_check_tx_quiet, args=(env, raw), daemon=True).start()
+    # the admission slot is taken HERE (typed overload error to the
+    # caller) and released by the worker thread after CheckTx: async
+    # submission must not become an unbounded thread/mempool-queue bomb
+    gate = _tx_gate(env)
+    gate.acquire(_node_scoreboard(env))
+    try:
+        threading.Thread(target=_check_tx_quiet, args=(env, raw, gate),
+                         daemon=True).start()
+    except BaseException:
+        # thread spawn failing (fd/thread exhaustion — exactly the
+        # overload this gate guards) must not leak the slot forever
+        gate.release()
+        raise
     return {"code": 0, "data": "", "log": "", "codespace": "", "hash": _hex(tx_hash(raw))}
 
 
-def _check_tx_quiet(env, raw):
+def _check_tx_quiet(env, raw, gate):
     try:
         env.node.mempool.check_tx(raw)
     except Exception:  # noqa: BLE001
         pass
+    finally:
+        gate.release()
 
 
 def broadcast_tx_sync(env, tx):
     raw = _decode_tx_param(tx)
+    gate = _tx_gate(env)
+    gate.acquire(_node_scoreboard(env))  # ErrOverloaded propagates, typed
     try:
         res = env.node.mempool.check_tx(raw)
         return {"code": res.code, "data": _b64(res.data), "log": res.log,
@@ -447,17 +518,34 @@ def broadcast_tx_sync(env, tx):
     except Exception as e:  # noqa: BLE001
         return {"code": 1, "data": "", "log": str(e), "codespace": "mempool",
                 "hash": _hex(tx_hash(raw))}
+    finally:
+        gate.release()
 
 
 def broadcast_tx_commit(env, tx):
     """Waits for the tx to be committed (reference: rpc/core/mempool.go:60)."""
     raw = _decode_tx_param(tx)
+    # the admission verdict comes FIRST: a shed request must cost nothing —
+    # subscribing before the gate would keep the event-bus lock and
+    # subscriber map hot under exactly the overload the gate exists to
+    # shed. The slot covers only the subscribe + CheckTx (the contended
+    # part); holding it through the commit wait would starve the gate on
+    # the block interval instead of on actual mempool pressure.
+    gate = _tx_gate(env)
+    gate.acquire(_node_scoreboard(env))
     q = tmevents.Query(f"{tmevents.EVENT_TYPE_KEY}='{tmevents.EVENT_TX}' AND "
                        f"{tmevents.TX_HASH_KEY}='{_hex(tx_hash(raw))}'")
     subscriber = f"btc-{_hex(tx_hash(raw))[:16]}"
-    sub = env.event_bus.subscribe(subscriber, q)
     try:
-        check = env.node.mempool.check_tx(raw)
+        sub = env.event_bus.subscribe(subscriber, q)
+    except BaseException:
+        gate.release()
+        raise
+    try:
+        try:
+            check = env.node.mempool.check_tx(raw)
+        finally:
+            gate.release()
         if not check.is_ok():
             return {"check_tx": {"code": check.code, "log": check.log},
                     "deliver_tx": {}, "hash": _hex(tx_hash(raw)), "height": "0"}
@@ -656,6 +744,30 @@ def unsafe_flush_mempool(env):
     return {}
 
 
+def unsafe_peers(env, ban=None, unban=None, duration=None):
+    """Peer misbehavior scoreboard view + manual ban control
+    (utils/peerscore.py; no reference analogue — the overload-resilience
+    plane's operator window, docs/OVERLOAD.md).
+
+    With no params, returns scores, active bans (seconds remaining),
+    per-offense counts, shed/rate-limit counters, and the threshold
+    config. ``ban``/``unban``: a node id to sanction/pardon manually
+    (``duration``: ban seconds, default = the configured schedule)."""
+    _require_unsafe(env)
+    board = _node_scoreboard(env)
+    if board is None:
+        raise ValueError("node has no peer scoreboard (switch not wired)")
+    if ban is not None:
+        if not isinstance(ban, str) or not ban:
+            raise ValueError("ban must be a non-empty node id")
+        board.ban(ban, float(duration) if duration is not None else None)
+    if unban is not None:
+        if not isinstance(unban, str) or not unban:
+            raise ValueError("unban must be a non-empty node id")
+        board.unban(unban)
+    return board.describe()
+
+
 def unsafe_nemesis(env, partition=None, heal=False, links=None):
     """Drive this node's peer-scoped link fault plane (utils/nemesis.py;
     no reference analogue — the e2e runner's partition/heal perturbations
@@ -719,4 +831,5 @@ ROUTES = {
     "dial_peers": dial_peers,
     "unsafe_flush_mempool": unsafe_flush_mempool,
     "unsafe_nemesis": unsafe_nemesis,
+    "unsafe_peers": unsafe_peers,
 }

@@ -56,6 +56,10 @@ class Syncer:
         # set by the reactor: fn(peer_id, height, format, index) requesting a
         # chunk from a peer over channel 0x61
         self.request_chunk = lambda peer_id, height, fmt, index: None
+        # peer misbehavior scoreboard (utils/peerscore.py), set by node
+        # wiring: an app-level reject_senders verdict is the strongest
+        # attribution statesync has — it scores, not just pool-rejects
+        self.scoreboard = None
 
     # --- discovery input ----------------------------------------------------
 
@@ -231,6 +235,8 @@ class Syncer:
                 index=index, chunk=body, sender=sender))
             for s in resp.reject_senders:
                 self.pool.reject_peer(s)
+                if self.scoreboard is not None and s:
+                    self.scoreboard.record(s, "statesync_reject")
                 for freed in q.discard_sender(s):
                     q.retry(freed)
             for r in resp.refetch_chunks:

@@ -239,3 +239,10 @@ class ErrVoteConflictingVotes(VoteError):
 
 class ErrVoteNonDeterministicSignature(VoteError):
     pass
+
+
+class ErrVoteInvalidSignature(VoteError):
+    """Signature verification failed — the one vote error whose blame is
+    unambiguous: votes are gossip-relayed, but a relay corrupting a vote
+    is as culpable as a forger, so the peer misbehavior scoreboard
+    (utils/peerscore.py) scores the delivering peer on this type."""

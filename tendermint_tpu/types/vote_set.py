@@ -22,6 +22,7 @@ from tendermint_tpu.types.validator_set import ValidatorSet
 from tendermint_tpu.utils.bits import BitArray
 from tendermint_tpu.types.vote import (
     ErrVoteConflictingVotes,
+    ErrVoteInvalidSignature,
     Vote,
     VoteError,
     is_vote_type_valid,
@@ -89,7 +90,7 @@ class VoteSet:
         if not verified and not val.pub_key.verify_signature(
             vote.sign_bytes(self.chain_id), vote.signature
         ):
-            raise VoteError(
+            raise ErrVoteInvalidSignature(
                 f"failed to verify vote with ChainID {self.chain_id} and "
                 f"PubKey {val.pub_key.bytes().hex()}: invalid signature"
             )
@@ -151,7 +152,7 @@ class VoteSet:
             for i in sorted(ok_by_i):
                 vote, val = prechecked[i]  # type: ignore[misc]
                 if not ok_by_i[i]:
-                    results[i] = (False, VoteError(
+                    results[i] = (False, ErrVoteInvalidSignature(
                         f"failed to verify vote with ChainID {self.chain_id} and "
                         f"PubKey {val.pub_key.bytes().hex()}: invalid signature"
                     ))

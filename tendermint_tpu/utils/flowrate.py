@@ -38,12 +38,21 @@ class Monitor:
         self._last_sample = self._start
         self._rate = 0.0
         self._peak = 0.0
+        # token bucket backing limit(): armed on first limit() call;
+        # update() then debits it (see limit() docstring)
+        self._budget: float | None = None
+        self._budget_t = self._start
 
     def update(self, n: int) -> int:
         """Record n transferred bytes (reference Update)."""
         with self._mtx:
             self._acc += n
             self._total += n
+            if self._budget is not None:
+                # debit the limiter's token bucket; going negative (the
+                # caller moved more than granted, e.g. a full socket
+                # buffer) just forces a longer refill sleep
+                self._budget -= n
             self._sample_locked()
         return n
 
@@ -76,13 +85,27 @@ class Monitor:
     def limit(self, want: int, rate: int, block: bool = True) -> int:
         """How many of `want` bytes may move now to hold `rate` B/s
         (reference Limit). rate <= 0 means unlimited. In blocking mode,
-        sleeps until at least one byte is allowed."""
+        sleeps until at least one byte is allowed.
+
+        Implemented as a token bucket refilled at `rate` and capped at
+        ONE second of burst credit (update() debits it). A cumulative
+        since-start budget would let a peer that idles for an hour bank
+        3600×rate of unspent allowance and then flood unthrottled for
+        gigabytes — the exact attack the recv-side limiter exists to
+        stop (docs/OVERLOAD.md)."""
         if rate <= 0 or want <= 0:
             return want
         while True:
             with self._mtx:
-                dur = time.monotonic() - self._start
-                allowed = int(rate * (dur + self._period)) - self._total
+                now = time.monotonic()
+                if self._budget is None:
+                    self._budget = rate * self._period  # small head start
+                else:
+                    self._budget = min(
+                        self._budget + rate * (now - self._budget_t),
+                        float(rate))  # burst cap: 1s of credit
+                self._budget_t = now
+                allowed = int(self._budget)
             if allowed >= 1 or not block:
                 return max(0, min(want, allowed))
             # sleep just long enough for one sample period of budget

@@ -57,6 +57,12 @@ class Gauge(_Metric):
         with self._mtx:
             self._values[self._key(labels)] = float(value)
 
+    def remove(self, **labels) -> None:
+        """Drop one labeled series entirely (per-peer gauges must not
+        leave a permanent exposition line per identity ever seen)."""
+        with self._mtx:
+            self._values.pop(self._key(labels), None)
+
     def add(self, delta: float = 1.0, **labels) -> None:
         k = self._key(labels)
         with self._mtx:
@@ -209,6 +215,24 @@ class NodeMetrics:
             "p2p", "peer_receive_bytes_total", "Bytes received.", labels=("chID",))
         self.peer_send_bytes = r.counter(
             "p2p", "peer_send_bytes_total", "Bytes sent.", labels=("chID",))
+        # overload-resilience plane (utils/peerscore.py, docs/OVERLOAD.md)
+        self.peer_score = r.gauge(
+            "p2p", "peer_score",
+            "Decaying per-peer misbehavior score (peerscore board).",
+            labels=("peer",))
+        self.peers_banned = r.counter(
+            "p2p", "peers_banned_total",
+            "Peers banned by the misbehavior scoreboard (re-offenses "
+            "count again).")
+        self.shed = r.counter(
+            "p2p", "shed_total",
+            "Messages/requests shed under overload, by channel class "
+            "(consensus gossip priorities + the rpc_tx admission gate).",
+            labels=("channel",))
+        self.rate_limited = r.counter(
+            "p2p", "rate_limited_total",
+            "Inbound messages dropped by per-peer per-channel ceilings.",
+            labels=("peer", "channel"))
         # robustness / chaos (no reference analogue: the fault-injection
         # layer, nemesis link plane, device breaker, and stall watchdog
         # are this tree's own; chaos runs must be visible on /metrics)
@@ -244,6 +268,12 @@ class NodeMetrics:
         self.watchdog_recoveries.add(0.0)
         self.sigcache_hits.add(0.0)
         self.sigcache_misses.add(0.0)
+        # ...and the overload counters: a node that never sheds or bans
+        # must scrape explicit zeros (dashboards alert on absence)
+        self.peers_banned.add(0.0)
+        for ch in ("vote", "proposal", "block_part", "rpc_tx"):
+            self.shed.add(0.0, channel=ch)
+        self.rate_limited.add(0.0, peer="", channel="")
 
 
 # Global registry hook for hot paths that have no handle on the node (the

@@ -23,7 +23,11 @@ Two fault shapes:
   redial immediately and rebuild peer gossip state from scratch).
 * **Link rules** — directed ``src>dst`` rules with the faults-style action
   set: ``drop``, ``delay`` (with seeded jitter), ``dup`` (deliver twice),
-  ``disconnect`` (tear the connection down like a transport error). A rule
+  ``disconnect`` (tear the connection down like a transport error), and
+  ``flood`` (send-side byzantine amplification: every outbound message
+  crossing the link additionally enqueues ``~param`` seeded CORRUPTED
+  copies — invalid-signature votes, unparseable/oversized gossip — the
+  overload-resilience scenario driver, docs/OVERLOAD.md). A rule
   on one direction only is an asymmetric link; ``%prob`` makes it flap.
 
 Determinism composes with the faults layer: every probabilistic decision
@@ -62,7 +66,9 @@ from dataclasses import dataclass, field
 from tendermint_tpu.utils import faults
 
 LINK_SITES = ("p2p.send", "p2p.recv", "p2p.dial")
-_LINK_ACTIONS = {"drop", "delay", "dup", "disconnect"}
+_LINK_ACTIONS = {"drop", "delay", "dup", "disconnect", "flood"}
+FLOOD_DEFAULT_COPIES = 8
+FLOOD_PAD_BYTES = 33  # corrupted copies grow by this (oversized-tx knob)
 
 
 def _match(pattern: str, node_id: str) -> bool:
@@ -278,6 +284,12 @@ class NemesisPlane:
                         continue
                     if r.ch is not None and r.ch != channel:
                         continue
+                    if r.action == "flood" and site == "p2p.recv":
+                        # flood is send-side only: the SENDER amplifies.
+                        # Matching at recv too would re-amplify every
+                        # corrupted copy in an in-process mesh (both ends
+                        # consult the same plane).
+                        continue
                     if r.prob is not None and _rng().random() >= r.prob:
                         continue
                     r.fired += 1
@@ -305,12 +317,54 @@ class NemesisPlane:
             raise faults.FaultDisconnect(site)
         if verdict == "disconnect":
             raise faults.FaultDisconnect(site)
-        if verdict == "dup" and site == "p2p.dial":
-            # a duplicated dial makes no sense; a schedule that asks for it
-            # is misconfigured -- fail loudly like faults._apply does
+        if verdict in ("dup", "flood") and site == "p2p.dial":
+            # a duplicated/flooded dial makes no sense; a schedule that asks
+            # for it is misconfigured -- fail loudly like faults._apply does
             raise faults.FaultError(
-                f"action 'dup' is not supported at site {site!r}")
+                f"action {verdict!r} is not supported at site {site!r}")
         return verdict
+
+    def flood_payloads(self, local: str, remote: str,
+                       channel: int | None, msg: bytes) -> list[bytes]:
+        """Corrupted copies for a message whose send just drew the
+        ``flood`` verdict: the byzantine amplification a flooding peer
+        performs on its own traffic. Copy count is the matching rule's
+        ``~param`` (default 8). Even copies get one seeded byte flipped in
+        the tail — inside a Vote's signature/timestamp region, so they
+        parse but fail signature verification and exercise the per-lane
+        drain attribution; odd copies get :data:`FLOOD_PAD_BYTES` of
+        seeded junk appended — unparseable/oversized at the receiver.
+        Deterministic: the k-th flood of a directed link is a pure
+        function of (TMTPU_FAULT_SEED, link, k)."""
+        with self._lock:
+            count = FLOOD_DEFAULT_COPIES
+            for r in self._rules:
+                if r.action != "flood":
+                    continue
+                if not (_match(r.src, local) and _match(r.dst, remote)):
+                    continue
+                if r.ch is not None and r.ch != channel:
+                    continue
+                count = int(r.param) if r.param is not None else count
+                break
+            key = ("flood", local[:16], remote[:16])
+            k = self._hits.get(key, 0) + 1
+            self._hits[key] = k
+            seed = self._seed()
+        rng = random.Random(f"{seed}:flood:{local[:16]}:{remote[:16]}:{k}")
+        out: list[bytes] = []
+        for i in range(max(count, 0)):
+            if not msg:
+                break
+            if i % 2 == 0:
+                buf = bytearray(msg)
+                # flip a byte near the tail (signature territory in a Vote)
+                pos = len(buf) - 1 - rng.randrange(min(24, len(buf)))
+                buf[pos] ^= (rng.randrange(255) + 1) & 0xFF
+                out.append(bytes(buf))
+            else:
+                out.append(msg + rng.randbytes(FLOOD_PAD_BYTES))
+        return out
 
     # --- observability -----------------------------------------------------
 

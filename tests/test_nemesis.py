@@ -387,10 +387,13 @@ def test_reconnect_backoff_resets_on_success_and_heal_kick():
         class node_info:
             node_id = "meme"
 
+    from tendermint_tpu.utils import peerscore
+
     s = sw.Switch.__new__(sw.Switch)  # no sockets: just the backoff state
     s.transport = _T()
     s.peers = {}
     s.logger = None
+    s.scoreboard = peerscore.PeerScoreBoard()  # consulted by the pass
     s._persistent_addrs = ["peer1@127.0.0.1:1"]
     s._reconnect_attempts = {}
     s._reconnect_next_try = {}

@@ -99,6 +99,44 @@ def test_sigcache_and_sharded_verify_metrics_exposed():
         sigcache.reset()
 
 
+import pytest
+
+
+@pytest.mark.quick
+def test_overload_counters_preseeded_in_exposition():
+    """ISSUE 5 satellite 5: the overload-resilience counters (docs/
+    OVERLOAD.md) are pre-seeded at 0 so a healthy node scrapes explicit
+    zeros — dashboards alert on absence."""
+    from tendermint_tpu.utils import metrics as tmmetrics
+
+    text = tmmetrics.NodeMetrics().registry.expose()
+    assert "tendermint_p2p_peers_banned_total 0.0" in text
+    for ch in ("vote", "proposal", "block_part", "rpc_tx"):
+        assert f'tendermint_p2p_shed_total{{channel="{ch}"}} 0.0' in text
+    assert ('tendermint_p2p_rate_limited_total{peer="",channel=""} 0.0'
+            in text)
+    assert "# TYPE tendermint_p2p_peer_score gauge" in text
+
+
+@pytest.mark.quick
+def test_overload_counters_flow_through_node_sampler_shapes():
+    """The scoreboard snapshot() contract the node sampler pumps: bans as
+    a counter delta, sheds/rate-limits keyed for the labeled counters,
+    scores as live gauges."""
+    from tendermint_tpu.utils import peerscore
+
+    b = peerscore.PeerScoreBoard()
+    b.record("noisy01", "invalid_signature")
+    b.ban("evil02", 60)
+    b.count_shed("vote", 3)
+    b.count_rate_limited("noisy01", "0x22")
+    s = b.snapshot()
+    assert s["scores"]["noisy01"] > 0
+    assert s["bans_total"] == 1
+    assert s["shed"] == {"vote": 3}
+    assert s["rate_limited"] == {("noisy01", "0x22"): 1}
+
+
 def _mk_result(events=None, code=0):
     return abci.ResponseDeliverTx(code=code, data=b"ok", gas_wanted=1,
                                   events=events or [])
@@ -293,6 +331,10 @@ def test_localnet_metrics_and_tx_search(tmp_path):
         # ISSUE 4: sigcache counters ride the same scrape (pre-seeded 0)
         assert "tendermint_crypto_sigcache_hits_total" in text
         assert "tendermint_crypto_sigcache_misses_total" in text
+        # ISSUE 5: overload-resilience counters ride it too (pre-seeded 0)
+        assert "tendermint_p2p_peers_banned_total" in text
+        assert 'tendermint_p2p_shed_total{channel="vote"}' in text
+        assert "tendermint_p2p_rate_limited_total" in text
     finally:
         node.stop()
         from tendermint_tpu.utils import metrics as tmmetrics
