@@ -83,9 +83,16 @@ class ABCIServer:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
-            self._conns.append(conn)
-            threading.Thread(target=self._conn_routine, args=(conn,),
-                             daemon=True).start()
+            try:
+                self._conns.append(conn)
+                threading.Thread(target=self._conn_routine, args=(conn,),
+                                 daemon=True).start()
+            except Exception:  # noqa: BLE001 - one bad conn must not kill
+                # the accept loop (the server would refuse forever after)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
     def _conn_routine(self, conn: socket.socket) -> None:
         """reference: socket_server.go:164 handleRequests."""
@@ -116,6 +123,9 @@ class ABCIServer:
                 # which buffers until a Flush request (socket_server.go:164).
                 wfile.flush()
         except (EOFError, OSError, ValueError):
+            return
+        except Exception:  # noqa: BLE001 - unexpected wire/app shapes tear
+            # down THIS connection only; the server stays up
             return
         finally:
             try:

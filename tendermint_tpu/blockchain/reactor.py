@@ -237,6 +237,14 @@ class BlockchainReactor(Reactor):
         return self._synced.wait(timeout)
 
     def _pool_routine(self) -> None:
+        try:
+            self._pool_loop()
+        except Exception as e:  # noqa: BLE001 - fail-stop, never die silent
+            if self.logger is not None:
+                self.logger.error("fast-sync pool routine crashed", err=e)
+            self._running = False
+
+    def _pool_loop(self) -> None:
         last_status = 0.0
         last_switch_check = 0.0
         started_at = time.monotonic()

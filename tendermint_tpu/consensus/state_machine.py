@@ -369,6 +369,22 @@ class ConsensusState:
     # --- the serialized event loop -----------------------------------------
 
     def _receive_routine(self) -> None:
+        """Crash shield around the drain loop: a stray exception must not
+        kill the one consensus drainer silently (with ``_running`` still
+        True nothing would ever restart it). Fail-stop instead: log, mark
+        the machine stopped, and let the stall watchdog hand the node to
+        fast-sync catchup (consensus/watchdog.py), which restarts a fresh
+        machine at the tip."""
+        try:
+            self._receive_loop()
+        except Exception as e:  # noqa: BLE001 - fail-stop, never die silent
+            if self.logger is not None:
+                self.logger.error("consensus receive routine crashed; "
+                                  "halting this machine for watchdog "
+                                  "recovery", err=e)
+            self._running = False
+
+    def _receive_loop(self) -> None:
         """reference: consensus/state.go:707-790. Strict ordering: internal
         queue drains before the peer queue; timeouts interleave."""
         while self._running:

@@ -51,10 +51,19 @@ class EvidenceReactor(Reactor):
 
     def _broadcast_routine(self, peer: Peer) -> None:
         sent: set[bytes] = set()
-        while self._peer_running.get(peer.id) and self.switch is not None:
-            evs, _sz = self.pool.pending_evidence(-1)
-            fresh = [ev for ev in evs if ev.hash() not in sent]
-            if fresh:
-                if peer.try_send(EVIDENCE_CHANNEL, msg_evidence_list(fresh)):
-                    sent.update(ev.hash() for ev in fresh)
-            time.sleep(BROADCAST_SLEEP_S)
+        try:
+            while self._peer_running.get(peer.id) and self.switch is not None:
+                evs, _sz = self.pool.pending_evidence(-1)
+                fresh = [ev for ev in evs if ev.hash() not in sent]
+                if fresh:
+                    if peer.try_send(EVIDENCE_CHANNEL, msg_evidence_list(fresh)):
+                        sent.update(ev.hash() for ev in fresh)
+                time.sleep(BROADCAST_SLEEP_S)
+        except Exception as e:  # noqa: BLE001 - gossip ends like a
+            # disconnect (peer teardown mid-send); a fresh routine starts
+            # on re-add — but say so: a systematic bug here would
+            # otherwise stop evidence gossip cluster-wide with no trail
+            logger = getattr(self.switch, "logger", None)
+            if logger:
+                logger.error("evidence broadcast routine ended",
+                             peer=peer.id, err=e)

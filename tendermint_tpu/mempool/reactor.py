@@ -89,20 +89,29 @@ class MempoolReactor(Reactor):
         """One-tx-at-a-time walk (reference: mempool/v0/reactor.go
         broadcastTxRoutine)."""
         sent_seq = 0
-        while self._peer_running.get(peer.id) and self.switch is not None:
-            entries = self.mempool.iter_txs()
-            progressed = False
-            for m in entries:
-                if m.seq <= sent_seq:
-                    continue
-                if peer.id in m.senders:
-                    sent_seq = m.seq
-                    progressed = True
-                    continue
-                # don't send txs for future heights the peer can't process yet
-                if peer.try_send(MEMPOOL_CHANNEL, msg_txs([m.tx])):
-                    sent_seq = m.seq
-                    progressed = True
-                break
-            if not progressed:
-                time.sleep(PEER_CATCHUP_SLEEP_S)
+        try:
+            while self._peer_running.get(peer.id) and self.switch is not None:
+                entries = self.mempool.iter_txs()
+                progressed = False
+                for m in entries:
+                    if m.seq <= sent_seq:
+                        continue
+                    if peer.id in m.senders:
+                        sent_seq = m.seq
+                        progressed = True
+                        continue
+                    # don't send txs for future heights the peer can't process yet
+                    if peer.try_send(MEMPOOL_CHANNEL, msg_txs([m.tx])):
+                        sent_seq = m.seq
+                        progressed = True
+                    break
+                if not progressed:
+                    time.sleep(PEER_CATCHUP_SLEEP_S)
+        except Exception as e:  # noqa: BLE001 - gossip ends like a
+            # disconnect (peer teardown mid-send); a fresh routine starts
+            # on re-add — but say so: a systematic bug here would
+            # otherwise stop tx gossip cluster-wide with no trail
+            logger = getattr(self.switch, "logger", None)
+            if logger:
+                logger.error("mempool gossip routine ended", peer=peer.id,
+                             err=e)

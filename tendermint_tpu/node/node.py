@@ -376,10 +376,16 @@ class Node:
 
             def notify():
                 ev = self.mempool.txs_available()
-                while self._running:
-                    if ev.wait(timeout=0.2):
-                        ev.clear()
-                        self.consensus.handle_txs_available()
+                try:
+                    while self._running:
+                        if ev.wait(timeout=0.2):
+                            ev.clear()
+                            self.consensus.handle_txs_available()
+                except Exception as e:  # noqa: BLE001 - notifier death would
+                    # silently stop empty-block-suppressed proposers
+                    if self.logger:
+                        self.logger.error("tx-available notifier crashed",
+                                          err=e)
 
             self._running = True
             self._tx_notify_thread = threading.Thread(target=notify, daemon=True)

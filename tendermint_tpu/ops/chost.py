@@ -96,6 +96,10 @@ def _load() -> ctypes.CDLL | None:
         _loading = True
         try:
             _lib = _load_locked()
+        except Exception:  # noqa: BLE001 - a failed build means "chost
+            # unavailable", never a dead background build thread (available()
+            # would return False forever with _tried unset)
+            _lib = None
         finally:
             _loading = False
             _tried = True

@@ -139,7 +139,9 @@ class SecretConnection:
                 nonce = b"\x00" * 4 + struct.pack("<Q", self._send_nonce)
                 self._send_nonce += 1
                 sealed = self._send_aead.encrypt(nonce, frame, None)
-                self._sock.sendall(sealed)
+                # _send_lock exists to serialize exactly this write (nonce
+                # order must match wire order); it guards nothing else
+                self._sock.sendall(sealed)  # tmlint: disable=lock-held-call
 
     def _read_frame(self) -> bytes:
         sealed = _read_exact(self._sock, SEALED_FRAME_SIZE)

@@ -28,6 +28,7 @@ from tendermint_tpu.crypto import keys
 from tendermint_tpu.encoding import proto
 from tendermint_tpu.types.proposal import Proposal
 from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.utils import log
 
 
 class RemoteSignerError(Exception):
@@ -132,11 +133,16 @@ class SignerServer:
     (reference: privval/signer_server.go + signer_dialer_endpoint.go)."""
 
     def __init__(self, priv_validator, addr: str,
-                 retries: int = 40, retry_interval_s: float = 0.25):
+                 retries: int = 40, retry_interval_s: float = 0.25,
+                 logger=None):
         self.pv = priv_validator
         self.addr = addr
         self.retries = retries
         self.retry_interval_s = retry_interval_s
+        # loud by default — a remote signer that silently stops signing is
+        # a validator outage; pass log.NopLogger() to silence
+        self.logger = (logger if logger is not None
+                       else log.Logger().with_(module="privval"))
         self._running = False
         self._thread: threading.Thread | None = None
         self._sock: socket.socket | None = None
@@ -174,8 +180,13 @@ class SignerServer:
             self._sock = sock
             try:
                 self._serve(sock)
-            except (OSError, EOFError, ValueError):
-                pass
+            except Exception as e:  # noqa: BLE001 - malformed requests must
+                # not end the signer permanently; drop the conn and re-dial —
+                # loudly, or a validator that silently stops signing (every
+                # conn dying on a systematic decode bug) has no trail
+                if self.logger:
+                    self.logger.error("signer connection dropped",
+                                      addr=self.addr, err=e)
             finally:
                 try:
                     sock.close()

@@ -714,7 +714,11 @@ def _dial_async(env, addrs: list, persistent: bool) -> None:
 
     def run():
         for a in addrs:
-            env.node.switch.dial_peer(a, persistent=persistent)
+            try:
+                env.node.switch.dial_peer(a, persistent=persistent)
+            except Exception:  # noqa: BLE001 - one refused dial must not
+                # abandon the rest of the list
+                continue
 
     threading.Thread(target=run, name="rpc-dial", daemon=True).start()
 

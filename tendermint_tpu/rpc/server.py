@@ -164,7 +164,9 @@ class RPCServer:
                         hdr.append(127)
                         hdr += struct.pack(">Q", n)
                     with send_lock:
-                        conn.sendall(bytes(hdr) + payload)
+                        # per-socket write-serialization lock: frames from
+                        # concurrent event pumps must not interleave
+                        conn.sendall(bytes(hdr) + payload)  # tmlint: disable=lock-held-call
 
                 subs: list = []
                 try:

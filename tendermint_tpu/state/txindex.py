@@ -222,6 +222,10 @@ class IndexerService:
             self._drain()
         except tmevents.SubscriptionCancelled:
             return  # unsubscribed during stop()
+        except Exception as e:  # noqa: BLE001 - indexing is best-effort;
+            # a dead drainer must at least say so
+            if self.logger:
+                self.logger.error("indexer drain crashed", err=e)
 
     def _drain(self) -> None:
         # Reference ordering (state/txindex/indexer_service.go:59-75): drive

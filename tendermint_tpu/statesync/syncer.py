@@ -205,21 +205,28 @@ class Syncer:
         """Request unfetched chunks from peers that have this snapshot
         (reference: syncer.go:380 fetchChunks)."""
         while True:
-            with self._mtx:
-                q = self._chunks
-            if q is None or q.done():
-                return
-            idx = q.allocate(time.monotonic(), self.chunk_request_timeout_s)
-            if idx is None:
-                time.sleep(0.05)
-                continue
-            peers = self.pool.peers_of(snapshot)
-            if not peers:
+            try:
+                with self._mtx:
+                    q = self._chunks
+                if q is None or q.done():
+                    return
+                idx = q.allocate(time.monotonic(), self.chunk_request_timeout_s)
+                if idx is None:
+                    time.sleep(0.05)
+                    continue
+                peers = self.pool.peers_of(snapshot)
+                if not peers:
+                    time.sleep(0.1)
+                    continue
+                peer = peers[idx % len(peers)]
+                self.request_chunk(peer, snapshot.height, snapshot.format, idx)
+                time.sleep(0.01)
+            except Exception as e:  # noqa: BLE001 - a transient p2p blip
+                # must not kill the fetcher (apply would then time out);
+                # retry-allocation handles any chunk left in flight
+                if self.logger:
+                    self.logger.error("chunk fetch iteration failed", err=e)
                 time.sleep(0.1)
-                continue
-            peer = peers[idx % len(peers)]
-            self.request_chunk(peer, snapshot.height, snapshot.format, idx)
-            time.sleep(0.01)
 
     def _apply_chunks(self, snapshot: Snapshot) -> None:
         """Apply in strict order, honoring refetch/ban feedback (reference:

@@ -1,0 +1,321 @@
+"""Runtime lock-order witness — the dynamic half of tmlint's `lock-order`
+rule (tools/tmlint, docs/LINT.md).
+
+The static rule sees the acquisition graph it can resolve; this witness
+sees the one that actually happens. With ``TMTPU_LOCKWITNESS=1`` (or an
+explicit :func:`install`), ``threading.Lock``/``threading.RLock`` are
+replaced by recording wrappers. Every acquisition appends to a
+thread-local held stack; holding A while acquiring B records the directed
+edge A→B in a global site graph (locks are keyed by their CREATION site,
+``file:line``, so per-peer/per-conn instances aggregate instead of
+exploding the graph). At teardown :func:`assert_acyclic` fails the test
+with the full cycle if two code paths ever took the same pair of lock
+sites in opposite orders — the classic latent deadlock that static
+analysis can miss and a lucky interleaving never trips.
+
+Overhead is bounded: O(held-stack depth) per acquire (depth is asserted
+small), edges capped at :data:`MAX_EDGES` (hitting the cap flips
+``truncated``, which the scenario tests also assert against). The witness
+is test-tooling: production never enables it.
+
+Used by the in-process mesh scenarios (tests/test_nemesis.py partition/
+heal smoke, tests/test_overload.py flood smoke) via::
+
+    with lockwitness.witness() as w:
+        ... run the scenario ...
+    # exiting asserts the runtime acquisition graph stayed acyclic
+
+Because Python resolves ``Lock``/``RLock``/``Condition``/``Event`` (and
+``queue.Queue``'s internals) through the ``threading`` module namespace at
+call time, installing the wrapper factories covers stdlib-composed
+primitives too. Locks created BEFORE install (module-level singletons)
+stay plain — the scenarios construct their nodes after install, which is
+where the cross-object ordering lives.
+"""
+
+from __future__ import annotations
+
+import _thread
+import os
+import sys
+import threading
+
+# Originals, captured at import so install/uninstall round-trips even if
+# something else also patched threading.
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+MAX_EDGES = 8192
+MAX_DEPTH = 16
+
+
+class Witness:
+    """The global acquisition-order graph + per-thread held stacks."""
+
+    def __init__(self) -> None:
+        # raw C lock: the witness must never recurse into itself
+        self._g = _thread.allocate_lock()
+        self._tls = threading.local()
+        self.enabled = False
+        self.reset()
+
+    def reset(self) -> None:
+        with self._g:
+            # (site_a, site_b) -> (thread name, count) — first-seen owner
+            self.edges: dict = {}
+            self.acquires = 0
+            self.max_depth = 0
+            self.truncated = False
+
+    # -- recording ----------------------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def note_acquire(self, site: str, inst: int) -> None:
+        if not self.enabled:
+            return
+        st = self._stack()
+        new_edges = []
+        for held_site, held_inst in st:
+            if held_inst == inst:
+                continue  # reentrant RLock re-acquire: not an ordering
+            # held_site == site is NOT skipped: two instances from the
+            # same creation site taken nested (peer A's lock held while
+            # taking peer B's) is the classic opposite-order hazard,
+            # recorded as a self-edge on the site
+            new_edges.append((held_site, site))
+        st.append((site, inst))
+        with self._g:
+            self.acquires += 1
+            if len(st) > self.max_depth:
+                self.max_depth = len(st)
+            for e in new_edges:
+                if e not in self.edges:
+                    if len(self.edges) >= MAX_EDGES:
+                        self.truncated = True
+                        break
+                    self.edges[e] = (threading.current_thread().name, 1)
+                else:
+                    name, n = self.edges[e]
+                    self.edges[e] = (name, n + 1)
+
+    def note_release(self, site: str, inst: int) -> None:
+        if not self.enabled:
+            return
+        st = getattr(self._tls, "stack", None)
+        if not st:
+            return
+        # locks are *usually* released LIFO but nothing enforces it
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == (site, inst):
+                del st[i]
+                return
+
+    def drop_instance(self, site: str, inst: int) -> int:
+        """Remove every held entry for one instance (Condition.wait's full
+        RLock release); returns how many were held."""
+        if not self.enabled:
+            return 0
+        st = getattr(self._tls, "stack", None)
+        if not st:
+            return 0
+        n = len(st)
+        st[:] = [e for e in st if e != (site, inst)]
+        return n - len(st)
+
+    def restore_instance(self, site: str, inst: int, count: int) -> None:
+        for _ in range(count):
+            self.note_acquire(site, inst)
+
+    # -- verdicts -----------------------------------------------------------
+
+    def cycles(self) -> list[list[str]]:
+        """Site-graph cycles, each as [a, b, ..., a]. Self-edges (same
+        creation site, different instances, nested) count: they are the
+        two-peers-in-opposite-order hazard."""
+        with self._g:
+            edges = list(self.edges)
+        graph: dict = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        out: list[list[str]] = []
+        # self-edges first
+        for a, b in sorted(edges):
+            if a == b:
+                out.append([a, a])
+        # DFS cycle detection with path recovery
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {v: WHITE for v in graph}
+        path: list[str] = []
+
+        def dfs(v) -> list[str] | None:
+            color[v] = GRAY
+            path.append(v)
+            for w in sorted(graph[v]):
+                if w == v:
+                    continue
+                if color[w] == GRAY:
+                    return path[path.index(w):] + [w]
+                if color[w] == WHITE:
+                    found = dfs(w)
+                    if found:
+                        return found
+            color[v] = BLACK
+            path.pop()
+            return None
+
+        for v in sorted(graph):
+            if color[v] == WHITE:
+                found = dfs(v)
+                if found:
+                    out.append(found)
+                    break
+        return out
+
+    def assert_acyclic(self) -> None:
+        cyc = self.cycles()
+        if cyc:
+            with self._g:
+                detail = {e: self.edges[e] for e in sorted(self.edges)
+                          if e[0] in cyc[0] and e[1] in cyc[0]}
+            raise AssertionError(
+                f"lock-order cycle observed at runtime: "
+                f"{' -> '.join(cyc[0])}; edges (first thread, count): "
+                f"{detail}")
+
+
+WITNESS = Witness()
+
+
+def _site(depth: int = 2) -> str:
+    f = sys._getframe(depth)
+    fn = f.f_code.co_filename
+    # shorten to the interesting tail: pkg/module.py
+    parts = fn.replace("\\", "/").split("/")
+    return f"{'/'.join(parts[-2:])}:{f.f_lineno}"
+
+
+class _WitnessLock:
+    """threading.Lock stand-in that reports to WITNESS."""
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self._wsite = site
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            WITNESS.note_acquire(self._wsite, id(self))
+        return ok
+
+    def release(self):
+        WITNESS.note_release(self._wsite, id(self))
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<WitnessLock {self._wsite} of {self._inner!r}>"
+
+
+class _WitnessRLock(_WitnessLock):
+    """threading.RLock stand-in; implements the Condition protocol hooks
+    (_release_save / _acquire_restore / _is_owned) by delegation so
+    Condition(RLock()) keeps exact semantics under the witness."""
+
+    def _release_save(self):
+        state = self._inner._release_save()
+        count = WITNESS.drop_instance(self._wsite, id(self))
+        return (state, count)
+
+    def _acquire_restore(self, saved):
+        state, count = saved
+        self._inner._acquire_restore(state)
+        WITNESS.restore_instance(self._wsite, id(self), count)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def locked(self):  # RLock has no .locked() pre-3.12; mirror if present
+        inner_locked = getattr(self._inner, "locked", None)
+        return inner_locked() if inner_locked is not None else False
+
+
+def _lock_factory():
+    return _WitnessLock(_REAL_LOCK(), _site())
+
+
+def _rlock_factory():
+    return _WitnessRLock(_REAL_RLOCK(), _site())
+
+
+def install() -> None:
+    """Patch threading.Lock/RLock with witness factories and start
+    recording. Idempotent."""
+    WITNESS.enabled = True
+    if threading.Lock is not _lock_factory:
+        threading.Lock = _lock_factory
+        threading.RLock = _rlock_factory
+
+
+def uninstall() -> None:
+    """Restore the real factories and stop recording. Wrapper locks
+    created while installed keep working (their note_* calls become
+    no-ops once disabled)."""
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    WITNESS.enabled = False
+
+
+def install_from_env() -> None:
+    if os.environ.get("TMTPU_LOCKWITNESS") == "1":
+        install()
+
+
+class witness:
+    """Context manager for scenario tests::
+
+        with lockwitness.witness() as w:
+            ...
+        # exit asserts acyclic + bounded overhead (unless the body raised)
+    """
+
+    def __init__(self, assert_on_exit: bool = True):
+        self.assert_on_exit = assert_on_exit
+        self._was_enabled = False
+
+    def __enter__(self) -> Witness:
+        # Nest cleanly inside a session-wide TMTPU_LOCKWITNESS=1 sweep:
+        # keep its accumulated edges and leave it recording on exit
+        # (asserting over the superset is strictly stronger).
+        self._was_enabled = WITNESS.enabled
+        if not self._was_enabled:
+            WITNESS.reset()
+        install()
+        return WITNESS
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if not self._was_enabled:
+            uninstall()
+        if exc_type is None and self.assert_on_exit:
+            WITNESS.assert_acyclic()
+            assert not WITNESS.truncated, (
+                f"lock witness edge graph truncated at {MAX_EDGES} edges")
+            assert WITNESS.max_depth <= MAX_DEPTH, (
+                f"held-lock stack reached depth {WITNESS.max_depth} "
+                f"(> {MAX_DEPTH}): lock nesting is out of hand")
+        return False

@@ -190,10 +190,13 @@ class NodeMetrics:
         self.batch_verify_sigs = r.counter(
             "consensus", "batch_verify_sigs_total",
             "Signatures verified through the batch verifier.")
-        self.verify_sharded = r.counter(
+        self.verify_sharded = r.counter(  # tmlint: disable=metrics-discipline
             "consensus", "verify_sharded_total",
             "Batch-verify dispatches routed through the multi-device "
             "shard_map mesh (parallel/batch_shard).", labels=("devices",))
+        # (devices label = mesh size at dispatch time; metrics.py cannot
+        # know it without importing jax, and a devices="" dummy series
+        # would poison the per-size sums test_multichip asserts on)
         self.sigcache_hits = r.counter(
             "crypto", "sigcache_hits_total",
             "Vote-drain signature verifications skipped via the verified-"
@@ -242,15 +245,20 @@ class NodeMetrics:
         self.watchdog_recoveries = r.counter(
             "consensus", "watchdog_recoveries_total",
             "Stall-watchdog hand-backs to fast-sync catchup.")
-        self.fault_site_hits = r.counter(
+        # chaos counters: label sets are bounded by CANONICAL_SITES x the
+        # fault-action table, but which (site, action) pairs exist depends
+        # on the TMTPU_FAULTS/TMTPU_NEMESIS schedule — series appear when
+        # the sampler copies faults.snapshot(), and a chaos-free node
+        # correctly scrapes none.
+        self.fault_site_hits = r.counter(  # tmlint: disable=metrics-discipline
             "faults", "site_hits_total",
             "Hits at rule-bearing fault sites (utils/faults.py).",
             labels=("site",))
-        self.faults_fired = r.counter(
+        self.faults_fired = r.counter(  # tmlint: disable=metrics-discipline
             "faults", "fired_total",
             "Fault-rule firings by site and action.",
             labels=("site", "action"))
-        self.nemesis_fired = r.counter(
+        self.nemesis_fired = r.counter(  # tmlint: disable=metrics-discipline
             "nemesis", "fired_total",
             "Nemesis link-plane firings by site and action "
             "('cut' = partition).", labels=("site", "action"))
@@ -274,6 +282,15 @@ class NodeMetrics:
         for ch in ("vote", "proposal", "block_part", "rpc_tx"):
             self.shed.add(0.0, channel=ch)
         self.rate_limited.add(0.0, peer="", channel="")
+        # p2p byte counters follow the same convention (chID values are
+        # bounded by the node's channel table, first traffic creates them)
+        self.peer_receive_bytes.add(0.0, chID="")
+        self.peer_send_bytes.add(0.0, chID="")
+        # the device-breaker pair has a two-kernel label universe: seed it
+        # fully so "breaker never tripped" is an explicit 0, not absence
+        for kernel in ("ed25519", "sr25519"):
+            self.breaker_open.set(0.0, kernel=kernel)
+            self.breaker_trips.set(0.0, kernel=kernel)
 
 
 # Global registry hook for hot paths that have no handle on the node (the

@@ -30,7 +30,7 @@ from tendermint_tpu.p2p.key import NodeKey
 from tendermint_tpu.privval.file_pv import MockPV
 from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
 from tendermint_tpu.types.ttime import Time
-from tendermint_tpu.utils import faults, nemesis
+from tendermint_tpu.utils import faults, lockwitness, nemesis
 
 SEED = 2026
 
@@ -585,33 +585,43 @@ def test_three_node_partition_heal_smoke(tmp_path):
     one partition/heal round. With 1|2 split neither side holds >2/3 power,
     so the split freezes the chain (safety: no commits, no forks); heal
     restores liveness. Tiny timeouts — one `-m 'not slow'` pass covers the
-    whole plane end to end."""
-    nodes = _start_mesh(tmp_path, 3)
-    ids = [n.node_key.id() for n in nodes]
-    desc = f"partition={ids[0]}|{ids[1]}/{ids[2]}"
-    try:
-        with repro("3-node partition/heal smoke", desc):
-            assert _wait(lambda: min(_heights(nodes)) >= 2, 30, 0.1), \
-                f"no initial progress: {_heights(nodes)}"
+    whole plane end to end.
 
-            nemesis.partition([[ids[0]], [ids[1], ids[2]]])
-            time.sleep(0.3)  # let in-flight commits land
-            split_h = _heights(nodes)
-            time.sleep(1.2)
-            frozen_h = _heights(nodes)
-            # no commits while split (≤1 height of in-flight slack)
-            assert all(f <= s + 1 for s, f in zip(split_h, frozen_h)), \
-                f"commits during 1|2 split: {split_h} -> {frozen_h}"
-            _audit_agreement(nodes)
+    Runs under the lock-order witness (TMTPU_LOCKWITNESS semantics,
+    utils/lockwitness.py): every Lock/RLock the 3 nodes create is
+    instrumented, and exiting the context asserts the observed
+    acquisition-order graph is acyclic with bounded witness overhead —
+    the dynamic half of tmlint's lock-order rule, run where the real
+    cross-node interleavings are."""
+    with lockwitness.witness() as w:
+        nodes = _start_mesh(tmp_path, 3)
+        ids = [n.node_key.id() for n in nodes]
+        desc = f"partition={ids[0]}|{ids[1]}/{ids[2]}"
+        try:
+            with repro("3-node partition/heal smoke", desc):
+                assert _wait(lambda: min(_heights(nodes)) >= 2, 30, 0.1), \
+                    f"no initial progress: {_heights(nodes)}"
 
-            nemesis.heal()
-            _relink_mesh(nodes)
-            target = max(frozen_h) + 2
-            assert _wait(lambda: min(_heights(nodes)) >= target, 60, 0.1), \
-                f"no liveness after heal: {_heights(nodes)} < {target}"
-            assert _audit_agreement(nodes) >= target - 1
-    finally:
-        _stop_all(nodes)
+                nemesis.partition([[ids[0]], [ids[1], ids[2]]])
+                time.sleep(0.3)  # let in-flight commits land
+                split_h = _heights(nodes)
+                time.sleep(1.2)
+                frozen_h = _heights(nodes)
+                # no commits while split (≤1 height of in-flight slack)
+                assert all(f <= s + 1 for s, f in zip(split_h, frozen_h)), \
+                    f"commits during 1|2 split: {split_h} -> {frozen_h}"
+                _audit_agreement(nodes)
+
+                nemesis.heal()
+                _relink_mesh(nodes)
+                target = max(frozen_h) + 2
+                assert _wait(lambda: min(_heights(nodes)) >= target, 60, 0.1), \
+                    f"no liveness after heal: {_heights(nodes)} < {target}"
+                assert _audit_agreement(nodes) >= target - 1
+        finally:
+            _stop_all(nodes)
+    # the witness actually saw the mesh run (not a silently-disabled no-op)
+    assert w.acquires > 0 and len(w.edges) > 0
 
 
 # --- slow-tier scenario matrix ---------------------------------------------

@@ -40,7 +40,7 @@ from tendermint_tpu.p2p.key import NodeKey
 from tendermint_tpu.privval.file_pv import MockPV
 from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
 from tendermint_tpu.types.ttime import Time
-from tendermint_tpu.utils import faults, nemesis, peerscore
+from tendermint_tpu.utils import faults, lockwitness, nemesis, peerscore
 
 SEED = 2027
 VOTE_CH = 0x22
@@ -664,11 +664,22 @@ def test_flood_smoke_single_node_flooding_peer_banned_no_stall(tmp_path):
     validator floods its 10-power peer through the nemesis flood action
     (every outbound message amplified with seeded corrupted copies —
     invalid-signature votes and unparseable junk). The victim must score
-    the flooder to a ban, refuse its redials, and keep committing."""
+    the flooder to a ban, refuse its redials, and keep committing.
+
+    Runs under the lock-order witness (utils/lockwitness.py): the flood
+    drives the scoreboard/shed/rate-limit locks hard against the p2p and
+    consensus locks, and the exit assert proves the acquisition order
+    stays acyclic even on the overload paths."""
     genesis, privs = _mk_weighted_genesis([10, 1])
-    nodes = [_mk_node(tmp_path, i, genesis, privs[i]) for i in range(2)]
-    ids = [n.node_key.id() for n in nodes]
-    desc = f"link={ids[1]}>*:flood~8"
+    with lockwitness.witness() as w:
+        nodes = [_mk_node(tmp_path, i, genesis, privs[i]) for i in range(2)]
+        ids = [n.node_key.id() for n in nodes]
+        desc = f"link={ids[1]}>*:flood~8"
+        _run_flood_smoke(nodes, ids, desc)
+    assert w.acquires > 0 and len(w.edges) > 0
+
+
+def _run_flood_smoke(nodes, ids, desc):
     try:
         with repro("flood smoke", desc):
             for n in nodes:

@@ -1,0 +1,30 @@
+"""tmlint — project-invariant static analysis for tendermint-tpu.
+
+A stdlib-`ast` analyzer encoding the invariants this repo enforces by hand
+in review (docs/LINT.md has the rule table and the rationale trail):
+
+* no blocking or callback-invoking calls under a held lock,
+* a cross-module lock-acquisition graph free of order cycles,
+* `jax.device_get`-class syncs only at the audited choke points,
+* every spawned thread crash-shielded and daemonized-or-joined,
+* labeled metrics pre-seeded, fault-site literals canonical + documented,
+* `TM_TPU_*`/`TMTPU_*` env knobs in parity with docs/CONFIG.md.
+
+Usage::
+
+    python -m tools.tmlint                  # whole tree, default rule set
+    python -m tools.tmlint --changed        # git-diff-scoped (pre-commit)
+    python -m tools.tmlint --rule lock-order tendermint_tpu
+
+Pure AST + text: no project imports, no jax, runs in seconds. Pragmas
+(`# tmlint: disable=RULE`) silence one line; `tools/tmlint/baseline.txt`
+grandfathers accepted findings (kept ~empty — fix, don't grandfather).
+"""
+
+from tools.tmlint.core import (  # noqa: F401
+    Finding,
+    Project,
+    load_baseline,
+    run_rules,
+)
+from tools.tmlint import checks  # noqa: F401  (registers the rule set)

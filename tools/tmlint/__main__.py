@@ -1,0 +1,100 @@
+"""CLI: ``python -m tools.tmlint [paths...] [options]``.
+
+Exit 0 when every finding is baselined (or none), 1 otherwise, 2 on
+usage errors. Output is one ``path:line RULE message`` per finding,
+byte-deterministic across runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from tools.tmlint import checks  # noqa: F401  (registers rules)
+from tools.tmlint import core
+
+# The package, the tooling, the tests (registry/parity rules cover them;
+# the concurrency rules scope themselves to tendermint_tpu/), and the two
+# top-level entry scripts — shared with lint_gate() and the tier-1 gate.
+DEFAULT_PATHS = core.DEFAULT_PATHS
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.tmlint",
+        description="project-invariant static analysis for tendermint-tpu")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to scan (default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--rule", action="append", dest="rules", metavar="RULE",
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--changed", action="store_true",
+                    help="report only findings in git-changed files "
+                         "(full tree still scanned so cross-file rules see "
+                         "the whole graph)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default tools/tmlint/baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings as the new baseline")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the summary line")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(core.RULES):
+            print(f"{name:24s} {core.RULES[name][1]}")
+        return 0
+
+    root = repo_root()
+    paths = args.paths or DEFAULT_PATHS
+    for p in paths:
+        if not os.path.exists(os.path.join(root, p)):
+            print(f"tmlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    t0 = time.monotonic()
+    try:
+        project = core.Project(root, core.collect_files(root, paths))
+        findings = core.run_rules(project, args.rules)
+    except ValueError as e:
+        print(f"tmlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.changed:
+        changed = core.changed_paths(root)
+        findings = [f for f in findings if f.path in changed]
+
+    if args.write_baseline:
+        if args.changed or args.paths or args.rules:
+            # a filtered run would TRUNCATE the baseline to the filtered
+            # findings, silently dropping grandfathered entries elsewhere
+            print("tmlint: --write-baseline requires a full default-scope "
+                  "all-rules run (drop --changed/--rule and explicit "
+                  "paths)", file=sys.stderr)
+            return 2
+        core.write_baseline(findings, args.baseline)
+        print(f"tmlint: wrote {len(findings)} finding(s) to baseline")
+        return 0
+
+    baseline = set() if args.no_baseline else core.load_baseline(args.baseline)
+    new, old = core.split_baselined(findings, baseline)
+    for f in new:
+        print(f.render())
+    if not args.quiet:
+        dt = time.monotonic() - t0
+        print(f"tmlint: {len(new)} finding(s), {len(old)} baselined, "
+              f"{len(project.files)} files, {dt:.2f}s", file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
