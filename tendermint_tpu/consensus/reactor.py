@@ -238,9 +238,14 @@ class ConsensusReactor(Reactor):
         with self._mtx:
             self._peer_states[peer.id] = ps
         peer.set("consensus_peer_state", ps)
-        for target in (self._gossip_data_routine, self._gossip_votes_routine,
-                       self._query_maj23_routine):
-            threading.Thread(target=target, args=(peer, ps), daemon=True).start()
+        # ONE gossip thread per peer (was three: data, votes, maj23 each
+        # owned a thread). Per-peer thread count is the limiting resource
+        # for the in-process scenario fabric (e2e/fabric.py budgets it at
+        # PER_PEER_THREADS per link side); the three loops all poll on the
+        # same peer-gossip cadence, so they share one loop with the maj23
+        # pass kept on its own slower clock.
+        threading.Thread(target=self._gossip_routine, args=(peer, ps),
+                         daemon=True).start()
         if not self.wait_sync:
             self._send_new_round_step(peer)
 
@@ -391,78 +396,95 @@ class ConsensusReactor(Reactor):
 
     # --- gossip routines (reference: consensus/reactor.go:540-1050) --------
 
-    def _gossip_data_routine(self, peer: Peer, ps: PeerState) -> None:
-        while ps.running and self.switch is not None:
-            if self.wait_sync:
-                time.sleep(0.1)
-                continue
-            rs = self.cs.rs
-            prs = ps.prs
-            sent = False
-            # send block parts the peer lacks for the current proposal
-            if (rs.proposal_block_parts is not None and prs.height == rs.height
-                    and prs.proposal_block_psh == rs.proposal_block_parts.header()):
-                ours = rs.proposal_block_parts.bit_array()
-                theirs = prs.proposal_block_parts
-                want = [i for i, have in enumerate(ours)
-                        if have and (i >= len(theirs) or not theirs[i])]
-                if want:
-                    i = random.choice(want)
-                    part = rs.proposal_block_parts.get_part(i)
-                    if part is not None and peer.try_send(
-                            DATA_CHANNEL, msg_block_part(rs.height, rs.round, part)):
-                        ps.set_has_block_part(prs.height, prs.round, i)
-                        sent = True
-            # catchup: peer is on an older height -> send stored block parts
-            elif (0 < prs.height < rs.height
-                  and prs.height >= self.cs.block_store.base):
-                self._gossip_data_for_catchup(peer, ps)
-                sent = True
-            # send proposal
-            if (not sent and rs.proposal is not None and prs.height == rs.height
-                    and prs.round == rs.round and not prs.proposal):
-                if peer.try_send(DATA_CHANNEL, msg_proposal(rs.proposal)):
-                    ps.set_has_proposal(rs.proposal)
-                    sent = True
-            if not sent:
-                time.sleep(self.cs.config.peer_gossip_sleep_duration_s)
+    def _gossip_routine(self, peer: Peer, ps: PeerState) -> None:
+        """The per-peer gossip loop: data (proposal/parts) + votes each
+        pass, the VoteSetMaj23 query on its own slower cadence. Busy
+        passes (something sent) loop immediately; idle passes sleep one
+        peer-gossip interval — same observable behavior as the former
+        three dedicated threads at a third of the thread bill."""
+        try:
+            maj23_sleep = self.cs.config.peer_query_maj23_sleep_duration_s
+            next_maj23 = time.monotonic() + maj23_sleep
+            while ps.running and self.switch is not None:
+                if self.wait_sync:
+                    time.sleep(0.1)
+                    continue
+                sent = self._gossip_data_step(peer, ps)
+                sent = self._gossip_votes_step(peer, ps) or sent
+                now = time.monotonic()
+                if now >= next_maj23:
+                    next_maj23 = now + maj23_sleep
+                    self._query_maj23_step(peer, ps)
+                if not sent:
+                    time.sleep(self.cs.config.peer_gossip_sleep_duration_s)
+        except Exception as e:  # noqa: BLE001 - a gossip-thread death ends
+            # like a disconnect (peer teardown mid-send starts a fresh
+            # routine on re-add), but a systematic bug here would silently
+            # starve the peer of proposals and votes — leave a trail
+            logger = getattr(self.switch, "logger", None)
+            if logger:
+                logger.error("consensus gossip routine ended",
+                             peer=peer.id, err=e)
 
-    def _gossip_data_for_catchup(self, peer: Peer, ps: PeerState) -> None:
-        """reference: consensus/reactor.go:631-700."""
+    def _gossip_data_step(self, peer: Peer, ps: PeerState) -> bool:
+        """One data-gossip pass; True when something was sent."""
+        rs = self.cs.rs
+        prs = ps.prs
+        # send block parts the peer lacks for the current proposal
+        if (rs.proposal_block_parts is not None and prs.height == rs.height
+                and prs.proposal_block_psh == rs.proposal_block_parts.header()):
+            ours = rs.proposal_block_parts.bit_array()
+            theirs = prs.proposal_block_parts
+            want = [i for i, have in enumerate(ours)
+                    if have and (i >= len(theirs) or not theirs[i])]
+            if want:
+                i = random.choice(want)
+                part = rs.proposal_block_parts.get_part(i)
+                if part is not None and peer.try_send(
+                        DATA_CHANNEL, msg_block_part(rs.height, rs.round, part)):
+                    ps.set_has_block_part(prs.height, prs.round, i)
+                    return True
+        # catchup: peer is on an older height -> send stored block parts
+        elif (0 < prs.height < rs.height
+              and prs.height >= self.cs.block_store.base):
+            return self._gossip_data_for_catchup(peer, ps)
+        # send proposal
+        if (rs.proposal is not None and prs.height == rs.height
+                and prs.round == rs.round and not prs.proposal):
+            if peer.try_send(DATA_CHANNEL, msg_proposal(rs.proposal)):
+                ps.set_has_proposal(rs.proposal)
+                return True
+        return False
+
+    def _gossip_data_for_catchup(self, peer: Peer, ps: PeerState) -> bool:
+        """reference: consensus/reactor.go:631-700. True when a part was
+        sent (the caller's loop owns the idle sleep)."""
         prs = ps.prs
         meta = self.cs.block_store.load_block_meta(prs.height)
         if meta is None:
-            time.sleep(self.cs.config.peer_gossip_sleep_duration_s)
-            return
+            return False
         with ps.mtx:
             if prs.proposal_block_psh != meta.block_id.part_set_header:
                 prs.proposal_block_psh = meta.block_id.part_set_header
                 prs.proposal_block_parts = BitArray(meta.block_id.part_set_header.total)
             want = [i for i, have in enumerate(prs.proposal_block_parts) if not have]
         if not want:
-            time.sleep(self.cs.config.peer_gossip_sleep_duration_s)
-            return
+            return False
         i = random.choice(want)
         part = self.cs.block_store.load_block_part(prs.height, i)
         if part is None:
-            time.sleep(self.cs.config.peer_gossip_sleep_duration_s)
-            return
+            return False
         if peer.try_send(DATA_CHANNEL, msg_block_part(prs.height, prs.round, part)):
             ps.set_has_block_part(prs.height, prs.round, i)
+            return True
+        return False
 
-    def _gossip_votes_routine(self, peer: Peer, ps: PeerState) -> None:
-        while ps.running and self.switch is not None:
-            if self.wait_sync:
-                time.sleep(0.1)
-                continue
-            rs = self.cs.rs
-            prs = ps.prs
-            if rs.votes is None:
-                time.sleep(0.05)
-                continue
-            if self._pick_send_vote(peer, ps, rs, prs):
-                continue
-            time.sleep(self.cs.config.peer_gossip_sleep_duration_s)
+    def _gossip_votes_step(self, peer: Peer, ps: PeerState) -> bool:
+        """One vote-gossip pass; True when a vote was sent."""
+        rs = self.cs.rs
+        if rs.votes is None:
+            return False
+        return self._pick_send_vote(peer, ps, rs, ps.prs)
 
     def _pick_send_vote(self, peer, ps, rs, prs) -> bool:
         """Pick one vote the peer lacks and send it (reference:
@@ -522,21 +544,19 @@ class ConsensusReactor(Reactor):
                     return False
         return False
 
-    def _query_maj23_routine(self, peer: Peer, ps: PeerState) -> None:
-        """reference: consensus/reactor.go:870-950."""
-        while ps.running and self.switch is not None:
-            time.sleep(self.cs.config.peer_query_maj23_sleep_duration_s)
-            if self.wait_sync:
+    def _query_maj23_step(self, peer: Peer, ps: PeerState) -> None:
+        """One VoteSetMaj23 announcement pass (reference:
+        consensus/reactor.go:870-950); paced by _gossip_routine's
+        peer_query_maj23_sleep_duration_s clock."""
+        rs = self.cs.rs
+        prs = ps.prs
+        if rs.votes is None or prs.height != rs.height:
+            return
+        for type_, vs in ((PREVOTE_TYPE, rs.votes.prevotes(prs.round)),
+                          (PRECOMMIT_TYPE, rs.votes.precommits(prs.round))):
+            if vs is None:
                 continue
-            rs = self.cs.rs
-            prs = ps.prs
-            if rs.votes is None or prs.height != rs.height:
-                continue
-            for type_, vs in ((PREVOTE_TYPE, rs.votes.prevotes(prs.round)),
-                              (PRECOMMIT_TYPE, rs.votes.precommits(prs.round))):
-                if vs is None:
-                    continue
-                maj, ok = vs.two_thirds_majority()
-                if ok:
-                    peer.try_send(STATE_CHANNEL,
-                                  msg_vote_set_maj23(rs.height, prs.round, type_, maj))
+            maj, ok = vs.two_thirds_majority()
+            if ok:
+                peer.try_send(STATE_CHANNEL,
+                              msg_vote_set_maj23(rs.height, prs.round, type_, maj))

@@ -1,5 +1,14 @@
-"""Manifest-driven e2e testnet runner (reference: test/e2e/)."""
+"""Manifest-driven e2e testnet runner (reference: test/e2e/) plus the
+in-process scenario fabric and seeded soak harness (docs/SOAK.md).
 
-from tendermint_tpu.e2e.runner import Manifest, Perturbation, Runner
+Heavy imports stay lazy: `fabric` and `soak` pull in node/consensus; the
+package import must stay cheap for the CLI."""
 
-__all__ = ["Manifest", "Perturbation", "Runner"]
+from tendermint_tpu.e2e.runner import (
+    Manifest,
+    Perturbation,
+    PowerChange,
+    Runner,
+)
+
+__all__ = ["Manifest", "Perturbation", "PowerChange", "Runner"]

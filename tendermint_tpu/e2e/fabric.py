@@ -1,0 +1,654 @@
+"""Scenario fabric: a 50-100+ node in-process mesh with configurable
+topology, validator churn, and enforced resource budgets (ROADMAP item 5;
+reference: test/e2e/ builds testnets of processes — this fabric builds them
+out of in-process nodes stitched over socketpairs, the seam the nemesis
+link plane cuts).
+
+The fabric exists because BFT bugs surface under scale, churn, and time:
+the 2-4 node harnesses in tests/test_nemesis.py and tests/test_overload.py
+prove mechanisms, not behavior at 50 validators. A :class:`Cluster` owns N
+in-process :class:`~tendermint_tpu.node.node.Node` objects peered over raw
+``socket.socketpair()`` links (no TCP, no `cryptography` dependency; every
+nemesis choke point lives in MConnection above the socket), wired in one of
+three topologies:
+
+* ``full`` — every pair linked. O(n^2) links: fine to ~10 nodes, ruinous
+  at 50 (2450 fds, ~17k threads before the gossip-thread merge).
+* ``k-regular:<k>[:<seed>]`` — a ring plus seeded random chord matchings
+  until every node has degree ~k. Diameter ~log n; the default for big
+  clusters.
+* ``hub-spoke:<h>`` — h fully-meshed hubs, every spoke linked to all hubs.
+  Diameter 2 at the cost of hot hubs.
+
+**Churn is a first-class action.** ``join_node()`` adds a node to a LIVE
+cluster (fast-sync catchup from genesis, or statesync bootstrap through a
+serving node's RPC + snapshots), ``promote()`` drives a voting-power change
+through the kvstore ``val:`` tx -> ABCI ``validator_updates`` ->
+``state/execution.py update_state`` path so the joiner starts voting two
+heights later, and ``remove_node()`` / ``restart_node()`` take a validator
+out mid-height. Evidence submitted mid-churn rides the normal evidence
+reactor.
+
+**Resource budgets are enforced, not hoped for.** One process hosting 50+
+nodes lives or dies on per-peer thread count and per-link fd count, so the
+fabric accounts for both: `PER_PEER_THREADS`/`NODE_BASE_THREADS` encode the
+claimed per-node footprint (the consensus reactor's three gossip threads
+were merged into one for exactly this budget), and
+:meth:`Cluster.assert_resource_budget` fails loudly when the live process
+exceeds what the topology predicts — a regression that quietly adds a
+per-peer thread breaks the budget test before it breaks a 100-node soak.
+
+Verification cost is shared through the existing seams: one process-wide
+BatchVerifier registry and one signature cache (crypto/sigcache.py), so a
+vote gossiped to 50 nodes pays ONE verification, not 50.
+
+See docs/SOAK.md for the soak driver that schedules perturbations against
+a cluster, and docs/NEMESIS.md for the link plane it drives.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import random
+import socket
+import threading
+import time
+
+from tendermint_tpu.utils import nemesis
+
+# --- resource budget constants ----------------------------------------------
+#
+# The per-node thread bill, by construction (asserted live by
+# Cluster.assert_resource_budget; tests/test_fabric.py pins the arithmetic):
+#
+#   per peer (one side of one link):
+#     2  MConnection send + recv routines
+#     1  consensus gossip routine (data+votes+maj23 merged; was 3 pre-fabric)
+#     1  evidence broadcast routine
+#     1  mempool gossip routine        (only when mempool broadcast is on)
+#   per node:
+#     1  switch reconnect loop
+#     1  consensus receive routine
+#     1  watchdog
+#     1  mempool tx-available notifier (only when mempool broadcast is on)
+#     +  transient: statesync/fast-sync threads during a join, timers
+#
+# NODE_BASE_THREADS carries one slot of transient slack per node on top of
+# the steady-state three/four. If either constant has to grow, the PR that
+# grows it is spending the fabric's scale budget and should say so.
+
+PER_PEER_THREADS = 4
+PER_PEER_THREADS_MEMPOOL = 1
+NODE_BASE_THREADS = 5
+FDS_PER_LINK = 2       # one socketpair end per side
+FDS_PER_NODE = 6       # WAL + sqlite handles (durable) + metrics/rpc slack
+
+
+class PlainConn:
+    """SecretConnection surface over a raw socket — the image lacks the
+    optional `cryptography` package, so in-process nodes are stitched
+    together unencrypted. Every nemesis choke point lives in MConnection
+    (framing, channels, fault sites), which runs unchanged on top."""
+
+    def __init__(self, sock):
+        self._s = sock
+
+    def write(self, b):
+        self._s.sendall(b)
+
+    def read(self, n):
+        try:
+            return self._s.recv(n)
+        except OSError:
+            return b""
+
+    def close(self):
+        try:
+            self._s.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._s.close()
+        except OSError:
+            pass
+
+
+def link_nodes(a, b) -> None:
+    """Register a<->b as real peers of each other over a socketpair (the
+    switch's own _add_peer: real Peer, real MConnection, all reactors)."""
+    sa, sb = socket.socketpair()
+    a.switch._add_peer(PlainConn(sa), b.transport.node_info, outbound=True)
+    b.switch._add_peer(PlainConn(sb), a.transport.node_info, outbound=False)
+
+
+# --- topology ----------------------------------------------------------------
+
+
+def full_mesh_edges(n: int) -> list[tuple[int, int]]:
+    return [(j, i) for i in range(n) for j in range(i)]
+
+
+def k_regular_edges(n: int, k: int, seed: int = 0) -> list[tuple[int, int]]:
+    """Ring + seeded random chord matchings to degree ~k. Deterministic in
+    (n, k, seed); every node ends within one of degree k, the graph is
+    connected (the ring guarantees it), and diameter is ~log n."""
+    if n < 3 or k < 2:
+        return full_mesh_edges(n)
+    k = min(k, n - 1)
+    edges = {(i, (i + 1) % n) if i < (i + 1) % n else ((i + 1) % n, i)
+             for i in range(n)}
+    rng = random.Random(f"fabric:{seed}:{n}:{k}")
+    degree = {i: 2 for i in range(n)}
+    # chord rounds: pair up nodes still under degree k, skipping self loops
+    # and duplicates; a bounded number of passes keeps this total even when
+    # parity leaves one node short
+    for _ in range(4 * k):
+        under = [i for i in range(n) if degree[i] < k]
+        if len(under) < 2:
+            break
+        rng.shuffle(under)
+        for a, b in zip(under[0::2], under[1::2]):
+            e = (a, b) if a < b else (b, a)
+            if a == b or e in edges:
+                continue
+            edges.add(e)
+            degree[a] += 1
+            degree[b] += 1
+    return sorted(edges)
+
+
+def hub_spoke_edges(n: int, hubs: int) -> list[tuple[int, int]]:
+    """Nodes [0, hubs) are hubs (fully meshed); every spoke links to all
+    hubs. Diameter 2: the scale topology when propagation latency matters
+    more than hub thread count."""
+    hubs = max(1, min(hubs, n))
+    edges = [(j, i) for i in range(hubs) for j in range(i)]
+    edges += [(h, s) for s in range(hubs, n) for h in range(hubs)]
+    return sorted(edges)
+
+
+def topology_edges(spec: str, n: int) -> list[tuple[int, int]]:
+    """Parse a topology spec: ``full``, ``k-regular:<k>[:<seed>]``, or
+    ``hub-spoke:<h>``."""
+    parts = spec.split(":")
+    kind = parts[0]
+    if kind == "full":
+        return full_mesh_edges(n)
+    if kind == "k-regular":
+        k = int(parts[1]) if len(parts) > 1 else 6
+        seed = int(parts[2]) if len(parts) > 2 else 0
+        return k_regular_edges(n, k, seed)
+    if kind == "hub-spoke":
+        return hub_spoke_edges(n, int(parts[1]) if len(parts) > 1 else 2)
+    raise ValueError(f"unknown topology {spec!r} "
+                     "(want full, k-regular:<k>[:<seed>], or hub-spoke:<h>)")
+
+
+# --- the cluster -------------------------------------------------------------
+
+
+def _priv_seed(tag: int, i: int) -> bytes:
+    """32 deterministic key-seed bytes; safe past i=255 (a 100+ node
+    cluster outgrows the bytes([x + i]) idiom of the small harnesses)."""
+    return (bytes([tag]) + i.to_bytes(4, "big")).ljust(32, b"\xa7")
+
+
+_GENERATION = itertools.count(1)
+
+
+class FabricNode:
+    """One in-process node plus the bookkeeping the cluster needs."""
+
+    def __init__(self, idx: int, node, priv, home: str, joined_via: str = ""):
+        self.idx = idx
+        self.node = node
+        self.priv = priv          # validator MockPV key (may be 0-power)
+        self.home = home
+        self.joined_via = joined_via  # "", "fastsync", "statesync"
+        self.links: set[int] = set()
+        # Monotonic across every node this process ever builds: restart
+        # detection for the soak auditor. id(node) alone is unsafe — the
+        # old Node gets garbage-collected and CPython can hand the SAME
+        # address to its replacement, which would silently skip the
+        # restarted node's full-prefix re-verification.
+        self.generation = next(_GENERATION)
+
+    @property
+    def id(self) -> str:
+        return self.node.node_key.id()
+
+    @property
+    def height(self) -> int:
+        return self.node.block_store.height
+
+
+class Cluster:
+    """N in-process nodes over socketpairs with a shared genesis.
+
+    The constructor only prepares configuration; :meth:`start` boots the
+    nodes and stitches the topology. ``n_validators`` (default: all nodes)
+    puts only the first ``n_validators`` nodes in the genesis validator
+    set — extra nodes are full nodes (and churn candidates)."""
+
+    def __init__(self, root: str, n: int, topology: str = "full",
+                 n_validators: int | None = None, power: int = 10,
+                 chain_id: str = "fabric-chain", mempool_broadcast: bool = True,
+                 durable: bool = False, snapshot_interval: int = 0,
+                 rpc_node: int = -1, metrics_node: int = -1, tweak=None,
+                 logger=None):
+        self.root = str(root)
+        self.n_initial = n
+        self.topology = topology
+        self.n_validators = n if n_validators is None else n_validators
+        self.power = power
+        self.chain_id = chain_id
+        self.mempool_broadcast = mempool_broadcast
+        self.durable = durable
+        self.snapshot_interval = snapshot_interval
+        self.rpc_node = rpc_node
+        self.metrics_node = metrics_node
+        self.tweak = tweak
+        self.logger = logger
+        self.nodes: dict[int, FabricNode] = {}
+        self._next_idx = 0
+        self._genesis = None
+        self._privs: list = []
+        self._baseline_threads = 0
+        self._baseline_fds = 0
+        self._lock = threading.Lock()
+
+    # --- construction -------------------------------------------------------
+
+    def _make_genesis(self):
+        from tendermint_tpu.crypto import ed25519
+        from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+        from tendermint_tpu.types.ttime import Time
+
+        self._privs = [ed25519.gen_priv_key(_priv_seed(0x11, i))
+                       for i in range(self.n_initial)]
+        self._genesis = GenesisDoc(
+            chain_id=self.chain_id,
+            genesis_time=Time(1700009000, 0),
+            validators=[GenesisValidator(b"", p.pub_key(), self.power)
+                        for p in self._privs[:self.n_validators]],
+        )
+
+    def _mk_config(self, idx: int):
+        from tendermint_tpu.config.config import test_config
+
+        cfg = test_config()
+        cfg.set_root(os.path.join(self.root, f"node{idx}"))
+        os.makedirs(cfg.base.root_dir, exist_ok=True)
+        cfg.base.fast_sync_mode = False
+        cfg.p2p.laddr = ""   # peered via socketpairs
+        cfg.p2p.pex = False  # no transport to dial discovered addrs through
+        cfg.rpc.laddr = ""
+        cfg.tx_index.indexer = "null"  # 1 thread/node the fabric can't spend
+        cfg.consensus.wal_path = os.path.join(cfg.base.root_dir, "cs.wal")
+        cfg.mempool.broadcast = self.mempool_broadcast
+        if self.durable:
+            cfg.base.db_backend = "sqlite"
+        if idx == self.rpc_node:
+            cfg.rpc.laddr = "tcp://127.0.0.1:0"
+            cfg.rpc.unsafe = True
+        if idx == self.metrics_node:
+            cfg.instrumentation.prometheus = True
+            cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+        if self.tweak is not None:
+            self.tweak(cfg, idx)
+        return cfg
+
+    def _mk_app(self):
+        from tendermint_tpu.abci.kvstore import KVStoreApplication
+
+        return KVStoreApplication(snapshot_interval=self.snapshot_interval)
+
+    def _mk_node(self, idx: int, priv, statesync_from: str = "",
+                 fast_sync: bool = False, joined_via: str = "") -> FabricNode:
+        from tendermint_tpu.crypto import ed25519
+        from tendermint_tpu.node.node import Node
+        from tendermint_tpu.p2p.key import NodeKey
+        from tendermint_tpu.privval.file_pv import MockPV
+
+        cfg = self._mk_config(idx)
+        if fast_sync:
+            cfg.base.fast_sync_mode = True
+        if statesync_from:
+            cfg.base.fast_sync_mode = True
+            cfg.statesync.enable = True
+            cfg.statesync.rpc_servers = (statesync_from,)
+            cfg.statesync.discovery_time_s = 0.5
+            cfg.statesync.chunk_request_timeout_s = 5.0
+            cfg.statesync.trust_period_s = 10 * 365 * 24 * 3600.0
+            seed = self.nodes[min(self.nodes)].node
+            meta = seed.block_store.load_block_meta(2)
+            if meta is None:
+                raise RuntimeError("statesync join needs the cluster at "
+                                   "height >= 2 for a trust anchor")
+            cfg.statesync.trust_height = 2
+            cfg.statesync.trust_hash = meta.block_id.hash.hex()
+        node_key = NodeKey(ed25519.gen_priv_key(_priv_seed(0x22, idx)))
+        node = Node(cfg, app=self._mk_app(), genesis=self._genesis,
+                    priv_validator=MockPV(priv), node_key=node_key,
+                    logger=self.logger)
+        return FabricNode(idx, node, priv, cfg.base.root_dir,
+                          joined_via=joined_via)
+
+    def start(self) -> None:
+        """Boot all initial nodes and stitch the topology."""
+        self._baseline_threads = threading.active_count()
+        self._baseline_fds = _open_fds()
+        if self._genesis is None:
+            self._make_genesis()
+        for i in range(self.n_initial):
+            fn = self._mk_node(i, self._privs[i])
+            self.nodes[i] = fn
+            fn.node.start()
+        self._next_idx = self.n_initial
+        for i, j in topology_edges(self.topology, self.n_initial):
+            self.link(i, j)
+
+    def stop(self) -> None:
+        for fn in list(self.nodes.values()):
+            try:
+                fn.node.stop()
+            except Exception:  # noqa: BLE001 - teardown best effort
+                pass
+        self.nodes.clear()
+
+    # --- links --------------------------------------------------------------
+
+    def link(self, i: int, j: int) -> None:
+        link_nodes(self.nodes[i].node, self.nodes[j].node)
+        self.nodes[i].links.add(j)
+        self.nodes[j].links.add(i)
+
+    def unlink(self, i: int, j: int) -> None:
+        a, b = self.nodes.get(i), self.nodes.get(j)
+        if a is not None and b is not None:
+            a.node.switch.stop_peer_by_id(b.id, "fabric unlink")
+            b.node.switch.stop_peer_by_id(a.id, "fabric unlink")
+        if a is not None:
+            a.links.discard(j)
+        if b is not None:
+            b.links.discard(i)
+
+    def relink_missing(self, timeout: float = 20.0) -> None:
+        """Re-establish severed links after a heal (the socketpair harness
+        has no transport to redial through, so the relink is explicit —
+        a real deployment's persistent-peer redial does this)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            missing = [(i, j) for i, fn in sorted(self.nodes.items())
+                       for j in sorted(fn.links)
+                       if j > i and j in self.nodes
+                       and (self.nodes[j].id not in fn.node.switch.peers
+                            or fn.id not in self.nodes[j].node.switch.peers)]
+            if not missing:
+                return
+            for i, j in missing:
+                self.nodes[i].node.switch.stop_peer_by_id(
+                    self.nodes[j].id, "relink")
+                self.nodes[j].node.switch.stop_peer_by_id(
+                    self.nodes[i].id, "relink")
+                try:
+                    link_nodes(self.nodes[i].node, self.nodes[j].node)
+                except Exception:  # noqa: BLE001 - teardown still in flight
+                    pass
+            time.sleep(0.1)
+        raise AssertionError("fabric relink failed after heal")
+
+    # --- nemesis handles (indices in, node ids out) -------------------------
+
+    def node_id(self, i: int) -> str:
+        return self.nodes[i].id
+
+    def partition(self, groups: list[list[int]]) -> None:
+        nemesis.partition([[self.node_id(i) for i in g if i in self.nodes]
+                           for g in groups])
+
+    def heal(self, relink: bool = True) -> None:
+        nemesis.heal()
+        if relink:
+            self.relink_missing()
+
+    def add_link_rule(self, src: int | str, dst: int | str,
+                      action_spec: str):
+        """Directed link rule with fabric indices: ``add_link_rule(0, 3,
+        "drop%0.5#0x22")``; ``"*"`` passes through as the wildcard.
+        Returns the installed LinkRule so a scheduler can expire exactly
+        this rule later (``nemesis.remove_link``)."""
+        s = src if isinstance(src, str) else self.node_id(src)
+        d = dst if isinstance(dst, str) else self.node_id(dst)
+        return nemesis.add_link(f"{s}>{d}:{action_spec}")
+
+    # --- heights / safety ---------------------------------------------------
+
+    def heights(self) -> dict[int, int]:
+        return {i: fn.height for i, fn in sorted(self.nodes.items())}
+
+    def min_height(self, among: list[int] | None = None) -> int:
+        hs = [fn.height for i, fn in self.nodes.items()
+              if among is None or i in among]
+        return min(hs) if hs else 0
+
+    def max_height(self) -> int:
+        return max((fn.height for fn in self.nodes.values()), default=0)
+
+    def wait_min_height(self, target: int, timeout: float,
+                        among: list[int] | None = None,
+                        poll: float = 0.1) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.min_height(among) >= target:
+                return True
+            time.sleep(poll)
+        return False
+
+    def block_hash(self, i: int, h: int) -> bytes | None:
+        meta = self.nodes[i].node.block_store.load_block_meta(h)
+        return None if meta is None else meta.block_id.hash
+
+    def audit_agreement(self, min_height: int = 1) -> int:
+        """Full-prefix fork audit: every committed height on every node
+        must carry one block hash cluster-wide. Returns heights audited;
+        raises AssertionError with the per-node map on any fork."""
+        audited = 0
+        for h in range(min_height, self.max_height() + 1):
+            hashes = {}
+            for i in sorted(self.nodes):
+                bh = self.block_hash(i, h)
+                if bh is not None:
+                    hashes[i] = bh
+            if len(hashes) >= 2:
+                audited += 1
+                if len(set(hashes.values())) != 1:
+                    raise AssertionError(
+                        f"fork at height {h}: "
+                        f"{ {i: v.hex()[:16] for i, v in hashes.items()} }")
+        return audited
+
+    # --- churn --------------------------------------------------------------
+
+    def join_node(self, statesync: bool = False, link_to: list[int] | None = None,
+                  links: int = 3) -> int:
+        """Add a fresh node to the LIVE cluster and return its index.
+
+        ``statesync=True`` bootstraps through the ``rpc_node``'s RPC (needs
+        ``snapshot_interval`` > 0 on the serving apps and the chain past the
+        trust anchor); otherwise the node fast-syncs from genesis. Either
+        way it lands in consensus as a non-validator until :meth:`promote`
+        gives it power."""
+        from tendermint_tpu.crypto import ed25519
+
+        idx = self._next_idx
+        self._next_idx += 1
+        statesync_from = ""
+        if statesync:
+            if self.rpc_node < 0 or self.rpc_node not in self.nodes:
+                raise RuntimeError("statesync join needs rpc_node >= 0 (a "
+                                   "serving node with an RPC listener) and "
+                                   "snapshot_interval > 0 on the apps")
+            rpc = self.nodes[self.rpc_node].node.rpc_server
+            if rpc is None:
+                raise RuntimeError("statesync join needs rpc_node >= 0")
+            statesync_from = "http://" + rpc.laddr.split("://", 1)[1]
+        priv = ed25519.gen_priv_key(_priv_seed(0x11, idx))
+        fn = self._mk_node(idx, priv, statesync_from=statesync_from,
+                           fast_sync=not statesync,
+                           joined_via="statesync" if statesync else "fastsync")
+        with self._lock:
+            self.nodes[idx] = fn
+        fn.node.start()
+        targets = (link_to if link_to is not None else
+                   sorted(self.nodes)[:links])
+        for j in targets:
+            if j != idx and j in self.nodes:
+                self.link(idx, j)
+        return idx
+
+    def remove_node(self, idx: int) -> None:
+        """Take a node out mid-height: unlink everywhere, then stop it.
+        O(degree), not O(cluster)."""
+        fn = self.nodes.get(idx)
+        if fn is None:
+            return
+        for j in sorted(fn.links):
+            self.unlink(idx, j)
+        with self._lock:
+            self.nodes.pop(idx, None)
+        fn.node.stop()
+
+    def restart_node(self, idx: int, links: int = 3) -> int:
+        """Stop a node and boot a replacement with the same validator key
+        (same home when durable; a fresh fast-sync from genesis when the
+        stores were memdb). Returns the node's (unchanged) index."""
+        fn = self.nodes.get(idx)
+        if fn is None:
+            raise KeyError(idx)
+        old_links = sorted(fn.links) or sorted(self.nodes)[:links]
+        priv = fn.priv
+        self.remove_node(idx)
+        if not self.durable:
+            # memdb stores die with the node but the WAL is a FILE in the
+            # reused home: a fresh-state replacement replaying the previous
+            # incarnation's #ENDHEIGHT markers is a hard consensus error
+            # (_catchup_replay refuses a WAL ahead of the state store)
+            try:
+                os.remove(os.path.join(fn.home, "cs.wal"))
+            except OSError:
+                pass
+        nfn = self._mk_node(idx, priv, fast_sync=not self.durable,
+                            joined_via="restart")
+        with self._lock:
+            self.nodes[idx] = nfn
+        nfn.node.start()
+        for j in old_links:
+            if j != idx and j in self.nodes:
+                self.link(idx, j)
+        return idx
+
+    def promote(self, idx: int, power: int, via: int | None = None) -> bytes:
+        """Change a validator's voting power through the ABCI path: submit
+        the kvstore ``val:`` tx to a live node's mempool; EndBlock's
+        validator_updates flow through state/execution.py and take effect
+        two heights after the tx commits. Returns the tx bytes."""
+        from tendermint_tpu.abci.kvstore import KVStoreApplication
+
+        pub = self.nodes[idx].priv.pub_key()
+        tx = KVStoreApplication.make_val_tx(pub.bytes(), power)
+        self.submit_tx(tx, via)
+        return tx
+
+    def validator_power(self, idx: int, at: int | None = None) -> int:
+        """Voting power of node ``idx``'s key in the current validator set
+        of node ``at`` (default: the lowest live index)."""
+        return self.validator_powers(at).get(idx, 0)
+
+    def validator_powers(self, at: int | None = None) -> dict[int, int]:
+        """index -> current voting power for every live node, from ONE
+        state load (the soak driver's quorum arithmetic runs this per
+        perturbation; per-node loads would be O(n) redundant I/O)."""
+        at = min(self.nodes) if at is None else at
+        st = self.nodes[at].node.state_store.load()
+        by_pub = {v.pub_key.bytes(): v.voting_power
+                  for v in st.validators.validators}
+        return {i: by_pub.get(fn.priv.pub_key().bytes(), 0)
+                for i, fn in self.nodes.items()}
+
+    def install_misbehavior(self, idx: int, name: str = "double_prevote") -> None:
+        from tendermint_tpu.consensus import misbehavior as mb
+
+        node = self.nodes[idx].node
+        hooks = {
+            "double_prevote": lambda: mb.double_prevote(node.switch),
+            "absent_prevote": lambda: mb.absent_prevote,
+        }
+        node.consensus.misbehaviors["prevote"] = hooks[name]()
+
+    # --- load ---------------------------------------------------------------
+
+    def submit_tx(self, tx: bytes, via: int | None = None) -> bool:
+        """CheckTx a transaction into one live node's mempool (gossip and
+        the proposer path take it from there). Returns acceptance."""
+        candidates = ([via] if via is not None else sorted(self.nodes))
+        for i in candidates:
+            fn = self.nodes.get(i)
+            if fn is None:
+                continue
+            try:
+                res = fn.node.mempool.check_tx(tx)
+                return bool(res is None or res.is_ok())
+            except Exception:  # noqa: BLE001 - full/duplicate: try the next
+                continue
+        return False
+
+    # --- resource budget ----------------------------------------------------
+
+    def expected_thread_budget(self) -> int:
+        per_peer = PER_PEER_THREADS + (
+            PER_PEER_THREADS_MEMPOOL if self.mempool_broadcast else 0)
+        peer_sides = sum(len(fn.links) for fn in self.nodes.values())
+        per_node = NODE_BASE_THREADS + (1 if self.mempool_broadcast else 0)
+        extra = (1 if self.metrics_node >= 0 else 0) + (
+            2 if self.rpc_node >= 0 else 0)
+        return len(self.nodes) * per_node + peer_sides * per_peer + extra
+
+    def expected_fd_budget(self) -> int:
+        links = sum(len(fn.links) for fn in self.nodes.values()) // 2
+        return links * FDS_PER_LINK + len(self.nodes) * FDS_PER_NODE + 16
+
+    def resource_report(self) -> dict:
+        return {
+            "nodes": len(self.nodes),
+            "links": sum(len(fn.links) for fn in self.nodes.values()) // 2,
+            "threads": threading.active_count() - self._baseline_threads,
+            "thread_budget": self.expected_thread_budget(),
+            "fds": max(0, _open_fds() - self._baseline_fds),
+            "fd_budget": self.expected_fd_budget(),
+        }
+
+    def assert_resource_budget(self) -> dict:
+        """The fabric-level budget assertion: the live process must not
+        exceed what the topology predicts. A regression in per-node
+        thread/fd footprint (say, a reactor growing a per-peer thread)
+        fails HERE, at 4 nodes in the quick tier, instead of melting the
+        100-node soak."""
+        r = self.resource_report()
+        assert r["threads"] <= r["thread_budget"], (
+            f"thread budget exceeded: {r['threads']} live threads over a "
+            f"budget of {r['thread_budget']} for {r['nodes']} nodes / "
+            f"{r['links']} links — a per-peer or per-node thread regression "
+            f"(see e2e/fabric.py budget constants)")
+        assert r["fds"] <= r["fd_budget"], (
+            f"fd budget exceeded: {r['fds']} fds over {r['fd_budget']} "
+            f"for {r['nodes']} nodes / {r['links']} links")
+        return r
+
+
+def _open_fds() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return 0

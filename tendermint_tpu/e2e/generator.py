@@ -14,12 +14,12 @@ import json
 import random
 from dataclasses import asdict
 
-from tendermint_tpu.e2e.runner import Manifest, Perturbation
+from tendermint_tpu.e2e.runner import Manifest, Perturbation, PowerChange
 
 # Dimension tables (reference: generator/generate.go testnetCombinations).
 _VALIDATORS = (2, 3, 4, 5)
 _FASTSYNC = ("v0", "v0", "v1", "v2")  # v0 weighted: the default path
-_PERTURB_ACTIONS = ("kill", "restart", "pause")
+_PERTURB_ACTIONS = ("kill", "restart", "pause", "partition")
 
 
 def generate_one(rng: random.Random, index: int = 0) -> Manifest:
@@ -30,11 +30,29 @@ def generate_one(rng: random.Random, index: int = 0) -> Manifest:
     # keep > 2/3 honest-and-up power to make progress while one node is
     # down, so small nets get at most one perturbation.
     for _ in range(rng.randrange(0, 2 if n_vals < 4 else 3)):
+        action = rng.choice(_PERTURB_ACTIONS)
+        node = rng.randrange(n_vals)
+        groups = []
+        if action == "partition":
+            # nemesis-driven cut: isolate `node` (the runner installs the
+            # symmetric cut over unsafe_nemesis and heals at revive time)
+            groups = [[node], [i for i in range(n_vals) if i != node]]
         perts.append(Perturbation(
-            node=rng.randrange(n_vals),
-            action=rng.choice(_PERTURB_ACTIONS),
+            node=node,
+            action=action,
             at_height=rng.randrange(3, max(4, target - 3)),
             revive_after_s=round(rng.uniform(0.5, 2.0), 1),
+            groups=groups,
+        ))
+    # Validator-power churn through the ABCI validator_updates path: roll
+    # a mid-run power change on a third of manifests (never to 0 on tiny
+    # sets — dropping a validator from a 2-set kills quorum outright).
+    powers = []
+    if rng.random() < 0.33:
+        powers.append(PowerChange(
+            node=rng.randrange(n_vals),
+            power=rng.choice((5, 15, 20) if n_vals < 4 else (0, 5, 15, 20)),
+            at_height=rng.randrange(3, max(4, target - 2)),
         ))
     # A byzantine node needs >= 4 validators (1 byzantine < 1/3 of 4);
     # roll it on a third of the big topologies.
@@ -47,6 +65,7 @@ def generate_one(rng: random.Random, index: int = 0) -> Manifest:
         target_height=target,
         load_txs=rng.randrange(5, 25),
         perturbations=perts,
+        power_changes=powers,
         byzantine_node=byz,
         fastsync_version=rng.choice(_FASTSYNC),
         statesync_joiner=n_vals >= 3 and rng.random() < 0.25,

@@ -108,6 +108,18 @@ class Perturbation:
 
 
 @dataclass
+class PowerChange:
+    """A voting-power change driven through the app's ``val:`` tx (ABCI
+    EndBlock validator_updates -> state/execution.py update_state): change
+    validator `node`'s power to `power` once the net reaches `at_height`.
+    The update lands in the validator set two heights after the tx commits."""
+
+    node: int
+    power: int
+    at_height: int
+
+
+@dataclass
 class Manifest:
     """reference: test/e2e/pkg/manifest.go (subset)."""
 
@@ -117,6 +129,7 @@ class Manifest:
     load_txs: int = 10
     starting_port: int = 0  # 0 -> pick a free range
     perturbations: list[Perturbation] = field(default_factory=list)
+    power_changes: list[PowerChange] = field(default_factory=list)
     # Node index to run byzantine (reference: maverick nodes in e2e
     # manifests, pkg/manifest.go Misbehaviors), -1 = none. The byzantine
     # node equivocates from the given height via TMTPU_MISBEHAVIOR; honest
@@ -133,7 +146,8 @@ class Manifest:
         with open(path) as f:
             doc = json.load(f)
         perts = [Perturbation(**p) for p in doc.pop("perturbations", [])]
-        return Manifest(perturbations=perts, **doc)
+        powers = [PowerChange(**p) for p in doc.pop("power_changes", [])]
+        return Manifest(perturbations=perts, power_changes=powers, **doc)
 
 
 def _free_port_base(n_ports: int) -> int:
@@ -223,6 +237,13 @@ class Runner:
         for i in range(self.m.validators):
             self.procs[i] = self._spawn(i)
 
+    def _load_targets(self) -> list[int]:
+        """Round-robin universe for client traffic: every node with an RPC
+        address, INCLUDING post-start joiners (a statesync-joined node that
+        never receives client load is a dead weight the old
+        `attempt % validators` cursor silently created)."""
+        return sorted(self.rpc_addrs)
+
     def load(self) -> None:
         """Submit load_txs round-robin over the nodes' RPC (reference:
         runner/load.go)."""
@@ -230,10 +251,11 @@ class Runner:
         attempt = 0
         deadline = time.monotonic() + 60
         while sent < self.m.load_txs and time.monotonic() < deadline:
-            node = attempt % self.m.validators
+            targets = self._load_targets()
+            node = targets[attempt % len(targets)]
             attempt += 1
             if node in self._paused or self.procs.get(node) is None:
-                if attempt % self.m.validators == 0:
+                if attempt % len(targets) == 0:
                     time.sleep(0.05)  # every node skipped: don't spin hot
                 continue
             tx = b"e2e%d=v%d" % (sent, sent)
@@ -260,10 +282,11 @@ class Runner:
         sent = 0
         attempt = 0  # round-robin cursor: advances even past dead/erroring
         while time.monotonic() < deadline:  # nodes, so one sick node can't
-            node = attempt % self.m.validators  # pin the whole window
+            targets = self._load_targets()  # pin the whole window
+            node = targets[attempt % len(targets)]
             attempt += 1
             if node in self._paused or self.procs.get(node) is None:
-                if attempt % self.m.validators == 0:
+                if attempt % len(targets) == 0:
                     time.sleep(0.05)  # every node skipped: don't spin hot
                 continue
             tx = b"load%d=v%d" % (sent, sent)
@@ -299,12 +322,15 @@ class Runner:
         budget basis: the wait fails on a height stall of timeout_s/3
         (load-scaled), or a hard cap of 4x timeout_s."""
         pending = sorted(self.m.perturbations, key=lambda p: p.at_height)
+        powers = sorted(self.m.power_changes, key=lambda p: p.at_height)
         revive_at: list[tuple[float, int, str]] = []
 
         def tick():
             h = self.max_height()
             while pending and h >= pending[0].at_height:
                 self._apply(pending.pop(0), revive_at)
+            while powers and h >= powers[0].at_height:
+                self._apply_power_change(powers.pop(0))
             now = time.monotonic()
             for t, node, action in list(revive_at):
                 if now >= t:
@@ -314,7 +340,7 @@ class Runner:
         self._progress_wait(
             self.max_height,
             lambda h: (h >= self.m.target_height and not pending
-                       and not revive_at),
+                       and not powers and not revive_at),
             idle_budget_s=timeout_s / 3.0, hard_cap_s=timeout_s * 4.0,
             what=f"testnet reaching height {self.m.target_height}",
             tick=tick)
@@ -346,6 +372,32 @@ class Runner:
             proc.send_signal(signal.SIGSTOP)
             self._paused.add(p.node)
         revive_at.append((time.monotonic() + p.revive_after_s, p.node, p.action))
+
+    def _apply_power_change(self, pc: PowerChange) -> None:
+        """Broadcast the app's ``val:`` tx changing validator `pc.node`'s
+        power (pubkey from the shared genesis doc). Best effort over every
+        reachable node: a power change racing a perturbation must not kill
+        the schedule."""
+        import base64
+
+        from tendermint_tpu.abci.kvstore import KVStoreApplication
+        from tendermint_tpu.types.genesis import GenesisDoc
+
+        gen = GenesisDoc.from_file(
+            os.path.join(self.workdir, "node0", "config", "genesis.json"))
+        if not 0 <= pc.node < len(gen.validators):
+            return
+        pub = gen.validators[pc.node].pub_key
+        tx = KVStoreApplication.make_val_tx(pub.bytes(), pc.power)
+        for i in self._load_targets():
+            if i in self._paused or self.procs.get(i) is None:
+                continue
+            try:
+                self._rpc(i, "broadcast_tx_sync",
+                          {"tx": base64.b64encode(tx).decode()})
+                return
+            except Exception:  # noqa: BLE001 - next node
+                continue
 
     def _revive(self, node: int, action: str) -> None:
         if action in ("kill", "restart"):

@@ -1,0 +1,585 @@
+"""Seeded long-soak harness over the scenario fabric (ROADMAP item 5;
+reference: the nightly e2e matrix of test/e2e/ — but one long RUN composing
+perturbations against sustained load, with the safety/liveness audit running
+CONTINUOUSLY instead of only at scenario end).
+
+A soak is: a :class:`~tendermint_tpu.e2e.fabric.Cluster`, a seeded
+:class:`SoakSchedule` of composed perturbations (partition/heal, link
+faults, flood, validator churn, power changes, restarts, equivocation),
+sustained tx load, and a background :class:`ContinuousAuditor` thread that
+re-checks full-prefix block-hash agreement and a liveness bound every few
+hundred milliseconds — a fork that heals before scenario end is still a
+safety violation, and only a continuous audit can see it.
+
+**Determinism contract.** A schedule is a pure function of
+``TMTPU_SOAK_SEED`` (plus node count and duration); every entry is
+expressible in the schedule grammar below, and any violation prints ONE
+repro line carrying the full knob set::
+
+    TMTPU_SOAK_REPRO: TMTPU_FAULT_SEED=2026 TMTPU_SOAK_SEED=7 \
+        TMTPU_SOAK_NODES=50 TMTPU_SOAK_TOPOLOGY=k-regular:6 \
+        TMTPU_SOAK_DURATION_S=30 TMTPU_SOAK_SCHEDULE='@3:partition~2:4|rest;@9:join'
+
+Re-running with those env vars replays the exact perturbation schedule
+(thread interleavings still vary — same contract as the nemesis layer's
+seeded link decisions).
+
+**Schedule grammar** (``TMTPU_SOAK_SCHEDULE``; ``;``-separated entries)::
+
+    @<t>:<kind>[~<dur>][:<arg>]
+
+    @3:partition~2:4|rest        cut {4} from everyone, heal after 2 s
+    @5:partition~1.5:0/1|2/3     explicit groups of node indices
+    @8:linkfault~2:*>3:drop%0.5  seeded flaky link for 2 s
+    @9:linkfault~2:*>3:delay~0.05  50 ms delay link (arg may contain ~)
+    @10:flood~1.5:1>0            nemesis flood action on a link
+    @12:join                     fast-sync joiner
+    @12:join_statesync           statesync joiner (needs rpc_node+snapshots)
+    @15:power:5:30               val-tx voting-power change via ABCI
+    @18:restart:2                stop + re-boot a node (fast-sync recovery)
+    @21:leave:6                  remove a node mid-height
+    @24:evidence:3               make node 3 equivocate (double_prevote)
+
+The driver tracks quorum arithmetic: while an installed partition leaves no
+side with >2/3 of the voting power, the auditor is told a stall is EXPECTED
+(that freeze is the safety property, not a liveness bug); heal restores the
+liveness clock after a grace window. See docs/SOAK.md for the cookbook.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tendermint_tpu.e2e.fabric import Cluster
+from tendermint_tpu.utils import faults, nemesis
+
+DEFAULT_NODES = 8
+DEFAULT_DURATION_S = 20.0
+DEFAULT_TOPOLOGY = "k-regular:4"
+
+_KINDS = ("partition", "linkfault", "flood", "join", "join_statesync",
+          "power", "restart", "leave", "evidence")
+
+
+@dataclass
+class SoakAction:
+    """One schedule entry: ``@<t>:<kind>[~<dur>][:<arg>]``. The duration
+    rides on the KIND segment (never the arg): link-fault args legally
+    contain ``~`` themselves (``delay~0.05`` is nemesis grammar), so a
+    trailing-``~`` duration would be ambiguous."""
+
+    at_s: float
+    kind: str
+    arg: str = ""
+    dur_s: float = 0.0
+
+    def describe(self) -> str:
+        out = f"@{self.at_s:g}:{self.kind}"
+        if self.dur_s:
+            out += f"~{self.dur_s:g}"
+        if self.arg:
+            out += f":{self.arg}"
+        return out
+
+    @staticmethod
+    def parse(entry: str) -> "SoakAction":
+        entry = entry.strip()
+        if not entry.startswith("@"):
+            raise ValueError(f"bad soak entry {entry!r} (want @t:kind[~dur][:arg])")
+        head, _, rest = entry[1:].partition(":")
+        kind_part, _, arg = rest.partition(":")
+        kind, _, d = kind_part.partition("~")
+        dur = float(d) if d else 0.0
+        if kind not in _KINDS:
+            raise ValueError(f"unknown soak action {kind!r} in {entry!r}")
+        return SoakAction(at_s=float(head), kind=kind, arg=arg, dur_s=dur)
+
+
+class SoakSchedule:
+    """An ordered list of :class:`SoakAction`; seeded generation and a
+    parse/describe round trip so a printed repro line IS the schedule."""
+
+    def __init__(self, actions: list[SoakAction]):
+        self.actions = sorted(actions, key=lambda a: a.at_s)
+
+    def describe(self) -> str:
+        return ";".join(a.describe() for a in self.actions)
+
+    @staticmethod
+    def parse(spec: str) -> "SoakSchedule":
+        return SoakSchedule([SoakAction.parse(e)
+                             for e in spec.split(";") if e.strip()])
+
+    @staticmethod
+    def generate(seed: int, duration_s: float, nodes: int,
+                 statesync_ok: bool = False) -> "SoakSchedule":
+        """A deterministic composed-perturbation schedule. Partitions only
+        ever cut a sub-1/3 minority (the majority keeps committing, so the
+        liveness bound stays armed through them); churn actions target
+        joiners and high indices so genesis quorum is never destroyed."""
+        rng = random.Random(f"soak:{seed}:{nodes}:{duration_s:g}")
+        actions: list[SoakAction] = []
+        joined = 0
+        # one perturbation every ~duration/7, starting after a warm-up
+        slots = max(3, int(duration_s / max(duration_s / 7.0, 2.0)))
+        step = duration_s * 0.7 / slots
+        t = duration_s * 0.15
+        kinds = ["partition", "linkfault", "join", "power", "flood",
+                 "restart", "evidence"]
+        if statesync_ok:
+            kinds.append("join_statesync")
+        for _ in range(slots):
+            t += step * (0.6 + 0.8 * rng.random())
+            if t >= duration_s * 0.9:
+                break
+            kind = rng.choice(kinds)
+            dur = round(min(step, 1.0 + 2.0 * rng.random()), 1)
+            if kind == "partition":
+                cut = rng.sample(range(nodes), max(1, (nodes - 1) // 4))
+                arg = "/".join(str(i) for i in sorted(cut)) + "|rest"
+                actions.append(SoakAction(round(t, 1), kind, arg, dur))
+            elif kind == "linkfault":
+                dst = rng.randrange(nodes)
+                act = rng.choice(("drop%0.5", "delay~0.05", "dup"))
+                actions.append(SoakAction(round(t, 1), kind,
+                                          f"*>{dst}:{act}", dur))
+            elif kind == "flood":
+                a, b = rng.sample(range(nodes), 2)
+                actions.append(SoakAction(round(t, 1), kind, f"{a}>{b}", dur))
+            elif kind in ("join", "join_statesync"):
+                joined += 1
+                actions.append(SoakAction(round(t, 1), kind))
+            elif kind == "power":
+                # grow a node's power mildly, or promote a joiner
+                idx = (nodes + joined - 1) if joined else rng.randrange(nodes)
+                actions.append(SoakAction(round(t, 1), kind,
+                                          f"{idx}:{rng.choice((5, 15, 20))}"))
+            elif kind == "restart":
+                actions.append(SoakAction(round(t, 1), kind,
+                                          str(rng.randrange(nodes))))
+            elif kind == "evidence":
+                actions.append(SoakAction(round(t, 1), kind,
+                                          str(rng.randrange(nodes))))
+        return SoakSchedule(actions)
+
+
+# --- continuous auditing -----------------------------------------------------
+
+
+@dataclass
+class Violation:
+    kind: str      # "fork" | "liveness" | "audit"
+    detail: str
+    at_s: float = 0.0
+
+    def __str__(self) -> str:
+        return f"[{self.kind} @{self.at_s:.1f}s] {self.detail}"
+
+
+class ContinuousAuditor:
+    """Background safety/liveness auditor over a live cluster.
+
+    Safety: incremental full-prefix agreement — the first node to commit
+    height h pins the cluster-wide hash for h; every other node's commit of
+    h is checked against it (including heights committed DURING partitions,
+    which an end-of-scenario audit of a healed cluster would also catch,
+    but hours later). Restarted node objects re-verify their whole prefix.
+
+    Liveness: the max committed height must advance within
+    ``liveness_budget_s`` whenever the driver hasn't declared a stall
+    expected (a quorum-cutting partition window + heal grace).
+    """
+
+    def __init__(self, cluster: Cluster, liveness_budget_s: float = 30.0,
+                 poll_s: float = 0.3, logger=None):
+        self.cluster = cluster
+        self.liveness_budget_s = liveness_budget_s
+        self.poll_s = poll_s
+        self.logger = logger
+        self.violations: list[Violation] = []
+        self.heights_audited = 0
+        self._agreed: dict[int, bytes] = {}
+        self._checked: dict[int, tuple[int, int]] = {}  # idx -> (node id(), h)
+        self._t0 = 0.0
+        self._last_advance = 0.0
+        self._best = 0
+        self._stall_ok_until = 0.0
+        self._stall_ok = False
+        self._stalled_reported = False
+        self._running = False
+        self._thread: threading.Thread | None = None
+
+    # the driver flips this around quorum-cutting perturbation windows
+    def expect_stall(self, on: bool, grace_s: float = 10.0) -> None:
+        self._stall_ok = on
+        if not on:
+            self._stall_ok_until = time.monotonic() + grace_s
+            self._last_advance = time.monotonic()
+
+    def start(self) -> None:
+        self._t0 = self._last_advance = time.monotonic()
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="soak-auditor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                self.sweep()
+            except Exception as e:  # noqa: BLE001 - the auditor must outlive
+                # any mid-churn race (a node torn down between snapshot and
+                # read); a sweep error is just retried next tick
+                if self.logger:
+                    self.logger.error("auditor sweep failed", err=e)
+            time.sleep(self.poll_s)
+
+    def _record(self, kind: str, detail: str) -> None:
+        self.violations.append(
+            Violation(kind, detail, at_s=time.monotonic() - self._t0))
+
+    def sweep(self) -> None:
+        """One audit pass (public so tests and the final drain call it
+        synchronously)."""
+        nodes = sorted(self.cluster.nodes.items())
+        best = self._best
+        for idx, fn in nodes:
+            # FabricNode carries a process-monotonic generation; id() alone
+            # can be REUSED by the allocator after the old Node is
+            # collected, which would silently skip a restarted node's
+            # full-prefix re-verification
+            key = (getattr(fn, "generation", None), id(fn.node))
+            prev_key, prev_h = self._checked.get(idx, (key, 0))
+            start_h = prev_h + 1 if prev_key == key else 1  # restart: re-verify
+            # a pruned store (statesync joiner) legitimately has nothing
+            # below its base — starting there keeps the stop-on-missing
+            # rule below from retrying unpersisted-looking heights forever
+            store = getattr(fn.node, "block_store", None)
+            start_h = max(start_h, getattr(store, "base", 1) or 1)
+            tip = fn.height
+            checked_to = start_h - 1
+            for h in range(start_h, tip + 1):
+                bh = self.cluster.block_hash(idx, h)
+                if bh is None:
+                    # store height is bumped before the meta persists:
+                    # stop HERE and re-read this height next sweep —
+                    # skipping past it would leave the node's commit of h
+                    # permanently unaudited (a fork there could then leave
+                    # with the node before the final audit sees it)
+                    break
+                checked_to = h
+                agreed = self._agreed.get(h)
+                if agreed is None:
+                    self._agreed[h] = bh
+                    self.heights_audited += 1
+                elif bh != agreed:
+                    self._record("fork",
+                                 f"height {h}: node {idx} committed "
+                                 f"{bh.hex()[:16]}, cluster agreed "
+                                 f"{agreed.hex()[:16]}")
+            self._checked[idx] = (key, checked_to)
+            best = max(best, tip)
+        now = time.monotonic()
+        if best > self._best:
+            self._best = best
+            self._last_advance = now
+            self._stalled_reported = False
+        elif (not self._stall_ok and now > self._stall_ok_until
+              and now - self._last_advance > self.liveness_budget_s
+              and not self._stalled_reported):
+            self._stalled_reported = True  # once per stall episode
+            self._record("liveness",
+                         f"no commit cluster-wide for "
+                         f"{now - self._last_advance:.1f}s "
+                         f"(budget {self.liveness_budget_s:.0f}s) at "
+                         f"height {self._best}")
+
+
+# --- the driver --------------------------------------------------------------
+
+
+@dataclass
+class SoakReport:
+    seed: int
+    nodes: int
+    topology: str
+    duration_s: float
+    schedule: str
+    heights: dict = field(default_factory=dict)
+    heights_audited: int = 0
+    txs_submitted: int = 0
+    actions_fired: int = 0
+    violations: list = field(default_factory=list)
+    repro: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def repro_line(seed: int, nodes: int, topology: str, duration_s: float,
+               schedule: str, statesync: bool = False) -> str:
+    """The single-line deterministic replay spec printed on any failure.
+    Carries EVERY knob the run was built from — including the statesync
+    flag, which implies the serving-node RPC + app-snapshot cluster
+    config a join_statesync action needs on replay."""
+    return (f"TMTPU_SOAK_REPRO: TMTPU_FAULT_SEED={faults.REGISTRY.seed} "
+            f"TMTPU_SOAK_SEED={seed} TMTPU_SOAK_NODES={nodes} "
+            f"TMTPU_SOAK_TOPOLOGY={topology} "
+            f"TMTPU_SOAK_DURATION_S={duration_s:g} "
+            + (f"TMTPU_SOAK_STATESYNC=1 " if statesync else "")
+            + f"TMTPU_SOAK_SCHEDULE='{schedule}'")
+
+
+class SoakDriver:
+    """Runs a schedule against a live cluster under sustained tx load with
+    the continuous auditor attached."""
+
+    def __init__(self, cluster: Cluster, schedule: SoakSchedule, seed: int,
+                 duration_s: float, tx_interval_s: float = 0.15,
+                 liveness_budget_s: float = 30.0, logger=None):
+        self.cluster = cluster
+        self.schedule = schedule
+        self.seed = seed
+        self.duration_s = duration_s
+        self.tx_interval_s = tx_interval_s
+        self.logger = logger
+        self.auditor = ContinuousAuditor(
+            cluster, liveness_budget_s=liveness_budget_s, logger=logger)
+        # (due time, what, payload): payload is the exact LinkRule list a
+        # scheduled link fault installed, so its expiry removes only THOSE
+        # rules — a global clear would wipe overlapping faults early, and
+        # nemesis.heal() deliberately leaves link rules standing
+        self._pending_heals: list[tuple[float, str, object]] = []
+        self.txs = 0
+        self.fired = 0
+
+    # --- quorum arithmetic: is a stall EXPECTED under this partition? -------
+
+    def _quorum_cut(self, groups: list[list[int]]) -> bool:
+        powers = {i: max(p, 0)
+                  for i, p in self.cluster.validator_powers().items()}
+        total = sum(powers.values())
+        if total <= 0:
+            return False
+        grouped = [sum(powers.get(i, 0) for i in g) for g in groups]
+        return not any(3 * p > 2 * total for p in grouped)
+
+    def _groups_from_arg(self, arg: str) -> list[list[int]]:
+        """``4|rest`` or ``0/1|2/3`` -> index groups; ``rest`` expands to
+        every live index not named elsewhere."""
+        named: set[int] = set()
+        raw = arg.split("|")
+        out: list[list[int]] = []
+        for g in raw:
+            if g == "rest":
+                out.append([])  # placeholder
+                continue
+            idxs = [int(p) for p in g.split("/") if p]
+            named.update(idxs)
+            out.append(idxs)
+        rest = [i for i in sorted(self.cluster.nodes) if i not in named]
+        return [g if g else rest for g in out]
+
+    # --- actions ------------------------------------------------------------
+
+    def _apply(self, a: SoakAction, now: float) -> None:
+        self.fired += 1
+        if self.logger:
+            self.logger.info("soak action", action=a.describe())
+        if a.kind == "partition":
+            groups = self._groups_from_arg(a.arg)
+            live = [[i for i in g if i in self.cluster.nodes] for g in groups]
+            if self._quorum_cut(live):
+                self.auditor.expect_stall(True)
+            self.cluster.partition(live)
+            self._pending_heals.append((now + (a.dur_s or 2.0), "heal", None))
+        elif a.kind == "linkfault":
+            src_dst, _, act = a.arg.partition(":")
+            src, _, dst = src_dst.partition(">")
+            rule = self.cluster.add_link_rule(
+                src if src == "*" else int(src),
+                dst if dst == "*" else int(dst), act)
+            self._pending_heals.append(
+                (now + (a.dur_s or 2.0), "remove_rules", [rule]))
+        elif a.kind == "flood":
+            src, _, dst = a.arg.partition(">")
+            rule = self.cluster.add_link_rule(int(src), int(dst), "flood~4")
+            self._pending_heals.append(
+                (now + (a.dur_s or 1.0), "remove_rules", [rule]))
+        elif a.kind == "join":
+            self.cluster.join_node(statesync=False)
+        elif a.kind == "join_statesync":
+            self.cluster.join_node(statesync=True)
+        elif a.kind == "power":
+            idx_s, _, pow_s = a.arg.partition(":")
+            idx = int(idx_s)
+            if idx in self.cluster.nodes:
+                self.cluster.promote(idx, int(pow_s))
+        elif a.kind == "restart":
+            idx = int(a.arg)
+            if idx in self.cluster.nodes:
+                self.cluster.restart_node(idx)
+        elif a.kind == "leave":
+            idx = int(a.arg)
+            # never destroy genesis quorum: only drop a node whose power
+            # the remaining set can lose (joiners, or <1/3 of total)
+            if idx in self.cluster.nodes and not self._quorum_cut(
+                    [[i for i in self.cluster.nodes if i != idx]]):
+                self.cluster.remove_node(idx)
+        elif a.kind == "evidence":
+            idx = int(a.arg)
+            if idx in self.cluster.nodes:
+                self.cluster.install_misbehavior(idx)
+
+    def _drain_heals(self, now: float) -> None:
+        for entry in list(self._pending_heals):
+            t, what, payload = entry
+            if now < t:
+                continue
+            self._pending_heals.remove(entry)
+            try:
+                if what == "heal":
+                    self.cluster.heal()
+                    self.auditor.expect_stall(False)
+                elif what == "remove_rules":
+                    # expire exactly the rules this fault installed:
+                    # partition-safe (heal keeps link rules standing) and
+                    # overlap-safe (other faults' rules stay live).
+                    # drop/delay/dup/flood never sever links, so no relink
+                    for rule in payload:
+                        nemesis.remove_link(rule)
+            except Exception as e:  # noqa: BLE001 - a failed relink is a
+                # finding, not a crashed soak: record it and keep driving
+                self.auditor._record("audit", f"{what} failed: {e}")
+                if what == "heal":
+                    self.auditor.expect_stall(False)
+
+    # --- the run loop -------------------------------------------------------
+
+    def run(self) -> SoakReport:
+        rng = random.Random(f"soak-load:{self.seed}")
+        pending = list(self.schedule.actions)
+        t0 = time.monotonic()
+        next_tx = 0.0
+        self.auditor.start()
+        try:
+            while True:
+                now = time.monotonic() - t0
+                if now >= self.duration_s and not self._pending_heals:
+                    break
+                while pending and now >= pending[0].at_s:
+                    a = pending.pop(0)
+                    try:
+                        self._apply(a, now)
+                    except Exception as e:  # noqa: BLE001 - one impossible
+                        # action (joiner before trust anchor, dead index)
+                        # must not abort the soak; it IS recorded
+                        self.auditor._record("audit",
+                                             f"action {a.describe()} failed: {e}")
+                self._drain_heals(now)
+                if now >= next_tx:
+                    next_tx = now + self.tx_interval_s
+                    tx = b"soak%d=v%d" % (self.txs, rng.randrange(1 << 30))
+                    if self.cluster.submit_tx(tx):
+                        self.txs += 1
+                time.sleep(0.05)
+        finally:
+            self.auditor.stop()
+        # final synchronous drain + full-prefix audit (belt over the
+        # incremental braces; also covers commits after the last sweep)
+        try:
+            self.auditor.sweep()
+            self.cluster.audit_agreement()
+        except AssertionError as e:
+            self.auditor._record("audit", str(e))
+        except Exception as e:  # noqa: BLE001 - teardown race
+            self.auditor._record("audit", f"final audit errored: {e}")
+        report = SoakReport(
+            seed=self.seed, nodes=self.cluster.n_initial,
+            topology=self.cluster.topology, duration_s=self.duration_s,
+            schedule=self.schedule.describe(),
+            heights=self.cluster.heights(),
+            heights_audited=self.auditor.heights_audited,
+            txs_submitted=self.txs, actions_fired=self.fired,
+            violations=[str(v) for v in self.auditor.violations],
+        )
+        report.repro = repro_line(self.seed, self.cluster.n_initial,
+                                  self.cluster.topology, self.duration_s,
+                                  report.schedule,
+                                  statesync=self.cluster.rpc_node >= 0)
+        if not report.ok:
+            print(report.repro)
+        return report
+
+
+def run_soak(root: str, seed: int = 1, nodes: int = DEFAULT_NODES,
+             duration_s: float = DEFAULT_DURATION_S,
+             topology: str = DEFAULT_TOPOLOGY, schedule_spec: str = "",
+             statesync_ok: bool = False, liveness_budget_s: float = 30.0,
+             tweak=None, logger=None) -> SoakReport:
+    """Build a cluster, run one seeded soak, tear down, report.
+
+    Env overrides (the repro-line knobs): ``TMTPU_SOAK_SEED``,
+    ``TMTPU_SOAK_NODES``, ``TMTPU_SOAK_TOPOLOGY``,
+    ``TMTPU_SOAK_DURATION_S``, ``TMTPU_SOAK_SCHEDULE``."""
+    seed = int(os.environ.get("TMTPU_SOAK_SEED", seed))
+    nodes = int(os.environ.get("TMTPU_SOAK_NODES", nodes))
+    topology = os.environ.get("TMTPU_SOAK_TOPOLOGY", topology)
+    duration_s = float(os.environ.get("TMTPU_SOAK_DURATION_S", duration_s))
+    schedule_spec = os.environ.get("TMTPU_SOAK_SCHEDULE", schedule_spec)
+    statesync_ok = os.environ.get(
+        "TMTPU_SOAK_STATESYNC", "1" if statesync_ok else "") == "1"
+    faults.configure([], seed=faults.REGISTRY.seed or 2026)
+    schedule = (SoakSchedule.parse(schedule_spec) if schedule_spec
+                else SoakSchedule.generate(seed, duration_s, nodes,
+                                           statesync_ok=statesync_ok))
+    cluster = Cluster(
+        root, nodes, topology=topology,
+        snapshot_interval=4 if statesync_ok else 0,
+        rpc_node=0 if statesync_ok else -1, tweak=tweak, logger=logger)
+    cluster.start()
+    try:
+        driver = SoakDriver(cluster, schedule, seed, duration_s,
+                            liveness_budget_s=liveness_budget_s,
+                            logger=logger)
+        return driver.run()
+    finally:
+        cluster.stop()
+        nemesis.clear()
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    import tempfile
+    from dataclasses import asdict
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--nodes", type=int, default=DEFAULT_NODES)
+    ap.add_argument("--duration", type=float, default=DEFAULT_DURATION_S)
+    ap.add_argument("--topology", default=DEFAULT_TOPOLOGY)
+    ap.add_argument("--schedule", default="")
+    ap.add_argument("--statesync", action="store_true")
+    args = ap.parse_args(argv)
+    with tempfile.TemporaryDirectory(prefix="tmtpu-soak-") as root:
+        report = run_soak(root, seed=args.seed, nodes=args.nodes,
+                          duration_s=args.duration, topology=args.topology,
+                          schedule_spec=args.schedule,
+                          statesync_ok=args.statesync)
+    print(json.dumps(asdict(report), indent=1, default=str))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

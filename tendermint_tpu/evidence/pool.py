@@ -36,6 +36,12 @@ class EvidencePool:
         # votes reported by consensus, to be turned into evidence
         self._consensus_buffer: list[tuple[Vote, Vote]] = []
         self.on_evidence = []  # callbacks(ev) for the reactor broadcast
+        # Monotonic change counter for the pending set / consensus buffer.
+        # The per-peer broadcast routines compare it against their last
+        # scan instead of re-running the pending_evidence DB iteration
+        # every tick — at fabric scale (300+ peer connections) the idle
+        # scans alone were most of a core (e2e/fabric.py, docs/SOAK.md).
+        self.version = 0
 
     # --- queries -----------------------------------------------------------
 
@@ -68,6 +74,7 @@ class EvidencePool:
                 return
             self.verify(ev)
             self._db.set(_pending_key(ev), ev.bytes())
+            self.version += 1
         for cb in self.on_evidence:
             cb(ev)
 
@@ -76,6 +83,7 @@ class EvidencePool:
         evidence/pool.go ReportConflictingVotes)."""
         with self._mtx:
             self._consensus_buffer.append((vote_a, vote_b))
+            self.version += 1
 
     def _process_consensus_buffer(self) -> None:
         """reference: evidence/pool.go processConsensusBuffer."""
@@ -99,6 +107,7 @@ class EvidencePool:
                     with self._mtx:
                         if not self.is_pending(ev) and not self.is_committed(ev):
                             self._db.set(_pending_key(ev), ev.bytes())
+                            self.version += 1
                     for cb in self.on_evidence:
                         cb(ev)
             except Exception:  # noqa: BLE001 - can't form evidence; drop
@@ -249,6 +258,8 @@ class EvidencePool:
                 age_ns = state.last_block_time.unix_ns() - ev.time().unix_ns()
                 if age_blocks > params.max_age_num_blocks and age_ns > params.max_age_duration_ns:
                     self._db.delete(k)
+            if evidence_list:
+                self.version += 1
         # Convert buffered conflicting votes into DuplicateVoteEvidence now
         # that the height's state is persisted (reference: evidence/pool.go
         # Update -> processConsensusBuffer).

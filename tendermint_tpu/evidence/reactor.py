@@ -41,6 +41,8 @@ class EvidenceReactor(Reactor):
         self._peer_running.pop(peer.id, None)
 
     def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        from tendermint_tpu.state.store import StateStoreError
+
         f = proto.fields(msg_bytes)
         for raw in f.get(1, []):
             try:
@@ -48,16 +50,38 @@ class EvidenceReactor(Reactor):
                 self.pool.add_evidence(ev)
             except EvidenceError:
                 pass
+            except StateStoreError:
+                # Evidence for a height WE don't have state for yet — a
+                # statesync node mid-bootstrap, or a pruned store — is our
+                # limitation, not peer misbehavior. Letting the error
+                # escape tears the peer down (Switch._on_receive), and
+                # since every honest peer gossips the same evidence, a
+                # bootstrapping joiner would shed its ENTIRE peer set and
+                # strand itself at height 0 (found by the fabric churn
+                # scenario, tests/test_fabric.py). Drop it; the evidence
+                # still reaches us committed in a block.
+                pass
 
     def _broadcast_routine(self, peer: Peer) -> None:
         sent: set[bytes] = set()
+        seen_version = -1
         try:
             while self._peer_running.get(peer.id) and self.switch is not None:
+                # Scan the pool only when it CHANGED since our last scan
+                # (pool.version): with hundreds of per-peer routines in one
+                # process (the scenario fabric), the idle every-tick DB
+                # iterations were most of a core while carrying nothing.
+                version = self.pool.version
+                if version == seen_version:
+                    time.sleep(BROADCAST_SLEEP_S)
+                    continue
                 evs, _sz = self.pool.pending_evidence(-1)
                 fresh = [ev for ev in evs if ev.hash() not in sent]
-                if fresh:
-                    if peer.try_send(EVIDENCE_CHANNEL, msg_evidence_list(fresh)):
-                        sent.update(ev.hash() for ev in fresh)
+                if not fresh:
+                    seen_version = version
+                elif peer.try_send(EVIDENCE_CHANNEL, msg_evidence_list(fresh)):
+                    sent.update(ev.hash() for ev in fresh)
+                    seen_version = version
                 time.sleep(BROADCAST_SLEEP_S)
         except Exception as e:  # noqa: BLE001 - gossip ends like a
             # disconnect (peer teardown mid-send); a fresh routine starts

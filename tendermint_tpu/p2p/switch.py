@@ -357,14 +357,17 @@ class Switch:
         that address's schedule."""
         while self._running:
             try:
-                self._reconnect_pass(self._reconnect_attempts,
-                                     self._reconnect_next_try)
+                if self._persistent_addrs:
+                    self._reconnect_pass(self._reconnect_attempts,
+                                         self._reconnect_next_try)
             except Exception as e:  # noqa: BLE001 - the redial thread must
                 # survive anything; losing it silently strands every
                 # persistent peer for the rest of the process lifetime
                 if self.logger:
                     self.logger.error("reconnect pass failed", err=e)
-            time.sleep(0.25)
+            # nothing to redial -> idle slowly: 50+ in-process switches
+            # (the scenario fabric) each waking 4x/s add up on one core
+            time.sleep(0.25 if self._persistent_addrs else 1.0)
 
     def _reconnect_pass(self, attempts: dict[str, int],
                         next_try: dict[str, float]) -> None:

@@ -57,6 +57,7 @@ class StateSyncReactor(Reactor):
         self.logger = logger
         if syncer is not None:
             syncer.request_chunk = self._request_chunk
+            syncer.request_snapshots = self._request_snapshots
 
     def get_channels(self) -> list[ChannelDescriptor]:
         # priorities/capacities from reference reactor.go:58-77
@@ -141,6 +142,14 @@ class StateSyncReactor(Reactor):
             p = self.switch.peers.get(peer_id)
         if p is not None:
             p.try_send(CHUNK_CHANNEL, msg_chunk_request(height, fmt, index))
+
+    def _request_snapshots(self) -> None:
+        """Re-broadcast SnapshotsRequest to every current peer: the syncer
+        calls this when its candidate pool runs dry, so serving nodes'
+        NEWER snapshots (taken after our add_peer hello) become visible."""
+        if self.switch is None:
+            return
+        self.switch.broadcast(SNAPSHOT_CHANNEL, msg_snapshots_request())
 
     def sync(self, discovery_time_s: float, give_up_after_s: float = 120.0):
         """Run one bootstrap attempt; returns (state, commit) (reference:

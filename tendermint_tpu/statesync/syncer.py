@@ -56,6 +56,14 @@ class Syncer:
         # set by the reactor: fn(peer_id, height, format, index) requesting a
         # chunk from a peer over channel 0x61
         self.request_chunk = lambda peer_id, height, fmt, index: None
+        # set by the reactor: fn() re-broadcasting SnapshotsRequest to every
+        # current peer. Discovery would otherwise be ONE-SHOT (a request at
+        # add_peer time): a syncer that exhausts its known snapshots — e.g.
+        # the first attempt raced the trust chain and got rejected — would
+        # wait out the whole give-up window while its peers keep taking
+        # NEWER snapshots it never hears about (found by the fabric's
+        # in-process churn scenario, tests/test_fabric.py).
+        self.request_snapshots = lambda: None
         # peer misbehavior scoreboard (utils/peerscore.py), set by node
         # wiring: an app-level reject_senders verdict is the strongest
         # attribution statesync has — it scores, not just pool-rejects
@@ -82,6 +90,7 @@ class Syncer:
         deadline = time.monotonic() + give_up_after_s
         tried: set[bytes] = set()
         transient_retries: dict[bytes, int] = {}
+        next_discovery = 0.0
         while time.monotonic() < deadline:
             snapshot = None
             for s in self.pool.ranked():
@@ -89,6 +98,15 @@ class Syncer:
                     snapshot = s
                     break
             if snapshot is None:
+                # out of candidates: re-poll the peers (paced by the
+                # discovery interval) — they advertise newer snapshots as
+                # their apps take them, and a snapshot that failed for a
+                # transient reason gets a second look once rediscovered
+                now = time.monotonic()
+                if now >= next_discovery:
+                    next_discovery = now + max(discovery_time_s, 0.1)
+                    tried.clear()
+                    self.request_snapshots()
                 time.sleep(min(discovery_time_s, 0.1))
                 continue
             tried.add(snapshot.key())

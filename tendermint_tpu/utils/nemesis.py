@@ -168,6 +168,18 @@ class NemesisPlane:
             self.active = True
         return r
 
+    def remove_link(self, rule: LinkRule) -> None:
+        """Remove exactly one previously-added rule (the object returned by
+        :meth:`add_link`). Scheduled-fault drivers (e2e/soak.py) expire
+        their own rules this way — a global ``clear()`` would also wipe
+        OTHER schedules' still-active rules and an installed partition."""
+        with self._lock:
+            try:
+                self._rules.remove(rule)
+            except ValueError:
+                pass
+            self.active = bool(self._groups or self._rules)
+
     def clear(self) -> None:
         """Drop everything: partition, link rules, hit counters."""
         with self._lock:
@@ -405,6 +417,10 @@ def heal() -> None:
 
 def add_link(spec_or_rule) -> LinkRule:
     return PLANE.add_link(spec_or_rule)
+
+
+def remove_link(rule: LinkRule) -> None:
+    PLANE.remove_link(rule)
 
 
 def clear() -> None:

@@ -61,6 +61,10 @@ _SLOW_MODULES = {
 def pytest_configure(config):
     config.addinivalue_line("markers", "quick: fast in-process tier (<3 min)")
     config.addinivalue_line("markers", "slow: subprocess/e2e tier")
+    config.addinivalue_line(
+        "markers",
+        "soak: long-running seeded soak scenarios (docs/SOAK.md); always "
+        "implies slow, so tier-1's `-m 'not slow'` never picks one up")
 
 
 def pytest_sessionfinish(session, exitstatus):
@@ -97,6 +101,11 @@ def _pin_kernel_path(request, monkeypatch):
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
+        # A soak-marked test is always slow-tier, whatever its module says.
+        if item.get_closest_marker("soak"):
+            if not item.get_closest_marker("slow"):
+                item.add_marker(pytest.mark.slow)
+            continue
         # An explicit @pytest.mark.quick/slow on the test wins over the
         # module default (a no-kernel gate in a kernel-heavy module can
         # opt into the quick tier).

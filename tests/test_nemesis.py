@@ -169,6 +169,22 @@ def test_prob_link_decisions_replay_from_seed():
     assert [nemesis.outcome("p2p.send", "n1", "n2") for _ in range(100)] != seq1
 
 
+def test_remove_link_removes_exactly_one_rule():
+    """The soak driver expires scheduled faults by removing the exact rule
+    it installed: overlapping faults keep theirs, a standing partition
+    keeps the plane active, and removal is idempotent."""
+    r1 = nemesis.add_link("a>b:drop")
+    r2 = nemesis.add_link("a>b:dup")
+    nemesis.remove_link(r1)
+    assert nemesis.outcome("p2p.send", "a", "b") == "dup"  # r2 untouched
+    nemesis.partition([["a"], ["b"]])
+    nemesis.remove_link(r2)
+    assert nemesis.PLANE.active  # the partition still holds the plane on
+    nemesis.remove_link(r2)  # idempotent
+    nemesis.heal()
+    assert not nemesis.PLANE.active
+
+
 def test_dup_at_dial_fails_loudly():
     nemesis.add_link("*>*:dup")
     with pytest.raises(faults.FaultError):
@@ -467,45 +483,11 @@ def _mk_node(tmp_path, i, genesis, priv, metrics=False):
                 node_key=node_key)
 
 
-class _PlainConn:
-    """SecretConnection surface over a raw socket — the image lacks the
-    optional `cryptography` package, so in-process nodes are stitched
-    together unencrypted. Every nemesis choke point lives in MConnection
-    (framing, channels, fault sites), which runs unchanged on top."""
-
-    def __init__(self, sock):
-        self._s = sock
-
-    def write(self, b):
-        self._s.sendall(b)
-
-    def read(self, n):
-        try:
-            return self._s.recv(n)
-        except OSError:
-            return b""
-
-    def close(self):
-        import socket as _socket
-
-        try:
-            self._s.shutdown(_socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._s.close()
-        except OSError:
-            pass
-
-
-def _link(a, b):
-    """Register a<->b as real peers of each other over a socketpair (the
-    switch's own _add_peer: real Peer, real MConnection, all reactors)."""
-    import socket as _socket
-
-    sa, sb = _socket.socketpair()
-    a.switch._add_peer(_PlainConn(sa), b.transport.node_info, outbound=True)
-    b.switch._add_peer(_PlainConn(sb), a.transport.node_info, outbound=False)
+# The socketpair stitching lives in the scenario fabric now
+# (tendermint_tpu/e2e/fabric.py) — one mesh harness for the 3-node smokes
+# here, the flood scenarios in test_overload.py, and 50+ node clusters.
+from tendermint_tpu.e2e.fabric import PlainConn as _PlainConn  # noqa: E402
+from tendermint_tpu.e2e.fabric import link_nodes as _link  # noqa: E402
 
 
 def _start_mesh(tmp_path, n, metrics_node=-1):

@@ -1,0 +1,218 @@
+"""Soak harness (tendermint_tpu/e2e/soak.py, docs/SOAK.md): schedule
+grammar determinism, the continuous safety/liveness auditor, the repro
+line, and a short driven soak.
+
+Quick tier: grammar/auditor/repro units plus a bounded 4-node mini-soak
+(one partition round + a joiner + a power change under tx load). The
+longer seeded soaks carry the `soak` marker, which conftest always folds
+into `slow` — tier-1 never runs them.
+"""
+
+import time
+
+import pytest
+
+from test_nemesis import _wait, repro  # noqa: F401 (shared harness)
+
+from tendermint_tpu.e2e import fabric, soak
+from tendermint_tpu.utils import faults, nemesis
+
+SEED = 2026
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    faults.configure([], seed=SEED)
+    nemesis.clear()
+    yield
+    nemesis.clear()
+    nemesis.PLANE.on_heal.clear()
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# Schedule grammar (quick)
+# ---------------------------------------------------------------------------
+
+
+def test_action_grammar_roundtrip():
+    for entry in ("@3:partition~2:4|rest", "@5.5:linkfault~2:*>3:drop%0.5",
+                  "@8:flood~1.5:1>0", "@10:join", "@10:join_statesync",
+                  "@12:power:5:30", "@14:restart:2", "@16:leave:6",
+                  "@18:evidence:3"):
+        a = soak.SoakAction.parse(entry)
+        assert a.describe() == entry
+    a = soak.SoakAction.parse("@3:partition~1.5:0/1|2/3")
+    assert (a.at_s, a.kind, a.arg, a.dur_s) == (3.0, "partition", "0/1|2/3", 1.5)
+    # the duration rides on the KIND segment: a link-fault arg that itself
+    # contains `~` (nemesis delay grammar) must survive intact
+    a = soak.SoakAction.parse("@8:linkfault:*>3:delay~0.05")
+    assert (a.kind, a.arg, a.dur_s) == ("linkfault", "*>3:delay~0.05", 0.0)
+    for bad in ("partition~2", "@x:join", "@3:frobnicate", ""):
+        with pytest.raises(ValueError):
+            soak.SoakAction.parse(bad)
+
+
+def test_schedule_generation_deterministic_and_parseable():
+    s1 = soak.SoakSchedule.generate(7, 30.0, 8)
+    s2 = soak.SoakSchedule.generate(7, 30.0, 8)
+    assert s1.describe() == s2.describe()
+    assert s1.describe() != soak.SoakSchedule.generate(8, 30.0, 8).describe()
+    # the printed schedule IS the schedule: parse -> describe is identity
+    assert soak.SoakSchedule.parse(s1.describe()).describe() == s1.describe()
+    assert s1.actions, "generated schedule is empty"
+    assert all(0 < a.at_s < 30.0 for a in s1.actions)
+    # statesync actions appear only when the cluster can serve them
+    kinds = {a.kind for a in soak.SoakSchedule.generate(7, 120.0, 8).actions}
+    assert "join_statesync" not in kinds
+
+
+def test_repro_line_is_single_line_and_complete():
+    line = soak.repro_line(7, 50, "k-regular:6", 30.0, "@3:join;@5:power:50:10")
+    assert "\n" not in line
+    for token in ("TMTPU_SOAK_REPRO:", "TMTPU_FAULT_SEED=", "TMTPU_SOAK_SEED=7",
+                  "TMTPU_SOAK_NODES=50", "TMTPU_SOAK_TOPOLOGY=k-regular:6",
+                  "TMTPU_SOAK_DURATION_S=30",
+                  "TMTPU_SOAK_SCHEDULE='@3:join;@5:power:50:10'"):
+        assert token in line, (token, line)
+    # a statesync-enabled run must carry the cluster-shape knob too:
+    # replaying a join_statesync schedule without it would misconfigure
+    # the cluster and report bogus violations
+    assert "TMTPU_SOAK_STATESYNC" not in line
+    line2 = soak.repro_line(7, 8, "full", 30.0, "@3:join_statesync",
+                            statesync=True)
+    assert "TMTPU_SOAK_STATESYNC=1" in line2 and "\n" not in line2
+
+
+# ---------------------------------------------------------------------------
+# Continuous auditor (quick) — stub cluster, no real nodes
+# ---------------------------------------------------------------------------
+
+
+class _StubNode:
+    def __init__(self):
+        self.height = 0
+
+
+class _StubFN:
+    def __init__(self):
+        self.node = _StubNode()
+
+    @property
+    def height(self):
+        return self.node.height
+
+
+class _StubCluster:
+    """The auditor's surface: .nodes {idx: .node/.height} + block_hash."""
+
+    def __init__(self, n):
+        self.nodes = {i: _StubFN() for i in range(n)}
+        self.hashes: dict[tuple[int, int], bytes] = {}
+
+    def commit(self, idx, h, digest: bytes):
+        self.hashes[(idx, h)] = digest
+        self.nodes[idx].node.height = max(self.nodes[idx].node.height, h)
+
+    def block_hash(self, i, h):
+        return self.hashes.get((i, h))
+
+
+def test_auditor_detects_fork_incrementally():
+    c = _StubCluster(3)
+    a = soak.ContinuousAuditor(c, liveness_budget_s=999)
+    for i in range(3):
+        c.commit(i, 1, b"\x01" * 32)
+    a.sweep()
+    assert not a.violations and a.heights_audited == 1
+    # node 2 commits a DIFFERENT block at height 2: a fork, caught on the
+    # next sweep even though heights 3+ keep agreeing afterwards
+    c.commit(0, 2, b"\x02" * 32)
+    c.commit(1, 2, b"\x02" * 32)
+    c.commit(2, 2, b"\xbb" * 32)
+    a.sweep()
+    assert len(a.violations) == 1 and a.violations[0].kind == "fork"
+    assert "height 2" in a.violations[0].detail
+    c.commit(0, 3, b"\x03" * 32)
+    c.commit(2, 3, b"\x03" * 32)
+    a.sweep()
+    assert len(a.violations) == 1  # no double-reporting of old heights
+
+
+def test_auditor_reverifies_restarted_node_prefix():
+    c = _StubCluster(2)
+    a = soak.ContinuousAuditor(c, liveness_budget_s=999)
+    c.commit(0, 1, b"\x01" * 32)
+    c.commit(1, 1, b"\x01" * 32)
+    a.sweep()
+    assert not a.violations
+    # node 1 restarts (new node object) and resyncs a FORKED height 1
+    c.nodes[1].node = _StubNode()
+    c.commit(1, 1, b"\xee" * 32)
+    a.sweep()
+    assert [v.kind for v in a.violations] == ["fork"]
+
+
+def test_auditor_liveness_bound_and_expected_stalls():
+    c = _StubCluster(2)
+    a = soak.ContinuousAuditor(c, liveness_budget_s=0.15)
+    c.commit(0, 1, b"\x01" * 32)
+    a._t0 = a._last_advance = time.monotonic()
+    a.sweep()
+    assert not a.violations
+    # an EXPECTED stall (quorum-cutting partition window) never trips
+    a.expect_stall(True)
+    time.sleep(0.3)
+    a.sweep()
+    assert not a.violations
+    # cleared with a short grace: the bound re-arms and then trips ONCE
+    a.expect_stall(False, grace_s=0.05)
+    time.sleep(0.4)
+    a.sweep()
+    a.sweep()
+    assert [v.kind for v in a.violations] == ["liveness"]
+    # progress resets the episode: a later stall reports again
+    c.commit(0, 2, b"\x02" * 32)
+    a.sweep()
+    time.sleep(0.3)
+    a.sweep()
+    assert [v.kind for v in a.violations] == ["liveness", "liveness"]
+
+
+# ---------------------------------------------------------------------------
+# Driven soaks
+# ---------------------------------------------------------------------------
+
+
+def test_mini_soak_explicit_schedule(tmp_path):
+    """The quick-tier soak smoke: 4 nodes under tx load run an explicit
+    composed schedule — minority partition (heal), a fast-sync joiner, and
+    a voting-power promotion of that joiner — with the continuous auditor
+    attached; zero violations and the joiner ends up in the validator set."""
+    schedule = "@2:partition~1.5:3|rest;@5:join;@7:power:4:10"
+    with repro("mini soak", schedule):
+        report = soak.run_soak(
+            str(tmp_path), seed=SEED, nodes=4, duration_s=12.0,
+            topology="full", schedule_spec=schedule, liveness_budget_s=60.0)
+        assert report.ok, f"violations: {report.violations}\n{report.repro}"
+        assert report.actions_fired == 3
+        assert report.txs_submitted > 0
+        assert max(report.heights.values()) >= 3
+        assert 4 in report.heights, "joiner never became part of the cluster"
+        assert report.heights_audited >= 3
+        # the repro line replays this exact run
+        assert f"TMTPU_SOAK_SCHEDULE='{schedule}'" in report.repro
+
+
+@pytest.mark.soak
+def test_generated_soak_long(tmp_path):
+    """A seeded GENERATED schedule on 8 nodes for ~45 s: partitions, link
+    faults, churn, restarts, equivocation — composed against sustained tx
+    load, audited continuously. The soak-marker tier: nightly material,
+    never tier-1."""
+    report = soak.run_soak(str(tmp_path), seed=11, nodes=8,
+                           duration_s=45.0, topology="k-regular:4",
+                           liveness_budget_s=90.0)
+    assert report.ok, f"violations: {report.violations}\n{report.repro}"
+    assert report.actions_fired >= 3
+    assert max(report.heights.values()) >= 5

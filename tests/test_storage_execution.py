@@ -99,6 +99,91 @@ def test_block_executor_applies_chain():
     assert store.load().last_block_height == 3
 
 
+def test_validator_power_change_propagates_and_batch_verifies():
+    """ISSUE 9 satellite: a voting-power change submitted as the kvstore
+    ``val:`` tx flows EndBlock validator_updates -> state/execution.py
+    update_state -> the height+2 ValidatorSet, and the changed validator's
+    votes then verify through the batched vote path (VoteSet.add_votes)
+    with the NEW power tallied — the unit-level shape of the fabric's
+    churn scenario (docs/SOAK.md)."""
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    gd, privs = _genesis(3)
+    state = make_genesis_state(gd)
+    app = KVStoreApplication()
+    store = StateStore(MemDB())
+    store.save(state)
+    bx = BlockExecutor(store, app)
+
+    # height 1: a plain tx
+    last_commit = Commit(height=0, round=0, block_id=BlockID(), signatures=[])
+    proposer = state.validators.get_proposer()
+    block1 = state.make_block(1, [b"k=v"], last_commit, [], proposer.address)
+    bid1, commit1 = _commit_for(state, block1, privs)
+    state, _ = bx.apply_block(state, bid1, block1)
+
+    # height 2 carries the power change: validator 0's power 10 -> 33
+    target = privs[0].pub_key()
+    tx = KVStoreApplication.make_val_tx(target.bytes(), 33)
+    block2 = state.make_block(
+        2, [tx], commit1, [], state.validators.get_proposer().address)
+    bid2, commit2 = _commit_for(state, block2, privs)
+    state, _ = bx.apply_block(state, bid2, block2)
+
+    # scheduled, not immediate: validators(h+1) still carry 10, the
+    # h+2 set carries 33 (reference: state/execution.go updateState)
+    cur = {v.pub_key.bytes(): v.voting_power for v in state.validators.validators}
+    nxt = {v.pub_key.bytes(): v.voting_power
+           for v in state.next_validators.validators}
+    assert cur[target.bytes()] == 10
+    assert nxt[target.bytes()] == 33
+    assert state.last_height_validators_changed == 4
+
+    # height 3 commits -> the 33-power set is the CURRENT set for height 4
+    block3 = state.make_block(
+        3, [], commit2, [], state.validators.get_proposer().address)
+    bid3, _commit3 = _commit_for(state, block3, privs)
+    state, _ = bx.apply_block(state, bid3, block3)
+    vals4 = state.next_validators
+    assert {v.pub_key.bytes(): v.voting_power
+            for v in vals4.validators}[target.bytes()] == 33
+    # and the per-height store agrees
+    assert store.load_validators(4).hash() == vals4.hash()
+
+    # the changed validator's votes verify through the BATCH path
+    # (VoteSet.add_votes: one dispatch()/resolve for the whole slice) and
+    # its NEW power is what tips the 2/3 tally
+    vs = VoteSet(state.chain_id, 4, 0, PRECOMMIT_TYPE, vals4)
+    votes = []
+    for p in privs:
+        idx, _val = vals4.get_by_address(p.pub_key().address())
+        v = Vote(type=PRECOMMIT_TYPE, height=4, round=0, block_id=bid3,
+                 timestamp=Time(1700000500, 0),
+                 validator_address=p.pub_key().address(),
+                 validator_index=idx)
+        v.signature = p.sign(v.sign_bytes(state.chain_id))
+        votes.append(v)
+    # validator 0 alone: 33 of 53 total is under 2/3 — no majority yet
+    res0 = vs.add_votes(votes[:1])
+    assert res0[0][0] and res0[0][1] is None
+    assert vs.two_thirds_majority()[1] is False
+    # +validator 1 (10): 43/53 > 2/3 — the new power is what tipped it
+    # (old powers 10+10=20/33 would NOT have)
+    res1 = vs.add_votes(votes[1:2])
+    assert res1[0][0] and res1[0][1] is None
+    maj, ok = vs.two_thirds_majority()
+    assert ok and maj == bid3
+    # a tampered signature from the changed validator is still rejected
+    bad = Vote(type=PRECOMMIT_TYPE, height=4, round=0, block_id=bid3,
+               timestamp=Time(1700000501, 0),
+               validator_address=privs[2].pub_key().address(),
+               validator_index=vals4.get_by_address(
+                   privs[2].pub_key().address())[0])
+    bad.signature = bytes(64)
+    res_bad = vs.add_votes([bad])
+    assert not res_bad[0][0] and res_bad[0][1] is not None
+
+
 def test_block_store_roundtrip():
     gd, privs = _genesis(1)
     state = make_genesis_state(gd)
