@@ -51,6 +51,7 @@ from tendermint_tpu.crypto import batch as crypto_batch
 from tendermint_tpu.types.block_id import BlockID
 from tendermint_tpu.types.part_set import PartSet
 from tendermint_tpu.types.validator_set import PendingCommitVerify
+from tendermint_tpu.utils import trace as _trace
 
 DEFAULT_DEPTH = 4
 
@@ -144,9 +145,20 @@ class VerifyAheadPipeline:
                 raise ValueError("second block has no LastCommit")
             if second.last_commit.block_id != first_id:
                 raise ValueError("second block's LastCommit is for a different block")
-            pending = state.validators.verify_commit_light_async(
-                state.chain_id, first_id, first.header.height,
-                second.last_commit, force_device=self._force_device(reactor))
+            tr = _trace.current()
+            if tr.enabled:
+                # the dispatch span's height is inherited by the crypto
+                # layer's host_prep/queue/readback phases (utils/trace.py)
+                with tr.span("fastsync.dispatch", height=height):
+                    pending = state.validators.verify_commit_light_async(
+                        state.chain_id, first_id, first.header.height,
+                        second.last_commit,
+                        force_device=self._force_device(reactor))
+            else:
+                pending = state.validators.verify_commit_light_async(
+                    state.chain_id, first_id, first.header.height,
+                    second.last_commit,
+                    force_device=self._force_device(reactor))
         except Exception as e:  # noqa: BLE001 - decided at resolve time, in order
             pending = PendingCommitVerify(error=e)
         return _Entry(height=height, first=first, second=second,
@@ -171,6 +183,15 @@ class VerifyAheadPipeline:
         Returns True when a block was applied (call again to drain), False
         when the next block isn't ready or its commit was invalid (peers
         already punished, exactly as the serial path)."""
+        tracer = getattr(reactor, "tracer", None)
+        if tracer is not None and tracer.enabled:
+            # spans from this step (speculative dispatches, the batched
+            # readback, the apply) land in the syncing node's recorder
+            with tracer.activate():
+                return self._process_next(reactor)
+        return self._process_next(reactor)
+
+    def _process_next(self, reactor) -> bool:
         pool = reactor.pool
         for _ in range(2):
             self._fill(reactor)
@@ -205,8 +226,9 @@ class VerifyAheadPipeline:
             reactor._punish_invalid(head.height, e)
             return False
         pool.pop_request()
-        reactor.block_store.save_block(head.first, head.first_parts,
-                                       head.second.last_commit)
-        reactor.state, _ = reactor.block_exec.apply_block(
-            reactor.state, head.first_id, head.first)
+        with _trace.current().span("fastsync.apply", height=head.height):
+            reactor.block_store.save_block(head.first, head.first_parts,
+                                           head.second.last_commit)
+            reactor.state, _ = reactor.block_exec.apply_block(
+                reactor.state, head.first_id, head.first)
         return True

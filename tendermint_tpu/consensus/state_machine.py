@@ -177,6 +177,10 @@ class ConsensusState:
         self.event_bus = event_bus if event_bus is not None else tmevents.EventBus()
         self.wal = wal
         self.logger = logger
+        # Flight recorder (utils/trace.py): node wiring swaps in the node's
+        # instance tracer so a 50-node in-process mesh never interleaves
+        # spans; a standalone machine records into the process default.
+        self.tracer = _trace.DEFAULT
 
         self.rs = cstypes.RoundState()
         self.state = None  # sm.State; set by update_to_state
@@ -376,7 +380,11 @@ class ConsensusState:
         fast-sync catchup (consensus/watchdog.py), which restarts a fresh
         machine at the tip."""
         try:
-            self._receive_loop()
+            # every span recorded on the consensus thread — including the
+            # crypto-layer verify phases dispatched from it — lands in THIS
+            # node's tracer (thread-local activation, utils/trace.py)
+            with self.tracer.activate():
+                self._receive_loop()
         except Exception as e:  # noqa: BLE001 - fail-stop, never die silent
             if self.logger is not None:
                 self.logger.error("consensus receive routine crashed; "
@@ -459,8 +467,18 @@ class ConsensusState:
                             blob = m.msg.wal_blob()
                             blob.peer_id = m.peer_id
                             self.wal.write(blob, _time.time_ns())
+                    tr = self.tracer
                     with self._mtx:
-                        self._handle_vote_batch(votes)
+                        if tr.enabled:
+                            # the drain span carries the height; verify
+                            # phases dispatched inside inherit it
+                            with tr.span("consensus.vote_drain",
+                                         height=self.rs.height,
+                                         round=self.rs.round,
+                                         votes=len(votes)):
+                                self._handle_vote_batch(votes)
+                        else:
+                            self._handle_vote_batch(votes)
                     continue
             # Any other message mutates state through _handle_msg: apply the
             # in-flight vote flush first so side effects stay arrival-order.
@@ -755,19 +773,21 @@ class ConsensusState:
                 _time.time_ns(),
             )
         self._n_steps += 1
-        # step-duration tracing (no-op beyond the enabled() check + timestamp
-        # bookkeeping; the timestamp/step update is unconditional so a
-        # disable/enable cycle can't produce a span covering the gap)
+        # step-duration tracing (no-op beyond the enabled attribute check +
+        # timestamp bookkeeping; the timestamp/step update is unconditional
+        # so a disable/enable cycle can't produce a span covering the gap)
         now = _time.monotonic()
         last = getattr(self, "_last_step_at", None)
         prev_step = getattr(self, "_last_step_name", None)
         self._last_step_at = now
         self._last_step_name = self.rs.step
-        if _trace.enabled() and last is not None and prev_step is not None:
-            # the measured duration belongs to the step we LEFT
-            _trace.record("consensus.step", now - last,
-                          height=self.rs.height, round=self.rs.round,
-                          step=prev_step)
+        if self.tracer.enabled and last is not None and prev_step is not None:
+            # the measured duration belongs to the step we LEFT; the name
+            # (not the int) is the step_duration histogram's label
+            self.tracer.record("consensus.step", now - last,
+                               height=self.rs.height, round=self.rs.round,
+                               step=cstypes.STEP_NAMES.get(prev_step,
+                                                           str(prev_step)))
         self.event_bus.publish_event_new_round_step(self._round_state_event())
         for cb in self.on_new_round_step:
             cb(self.rs)
@@ -976,6 +996,7 @@ class ConsensusState:
         if rs.height != height or round_ < rs.round or (
                 rs.round == round_ and STEP_PRECOMMIT <= rs.step):
             return
+        self.tracer.mark("consensus.precommit", height=height, round=round_)
 
         def done():
             rs.round = round_
@@ -1057,6 +1078,8 @@ class ConsensusState:
         rs = self.rs
         if rs.height != height or STEP_COMMIT <= rs.step:
             return
+        self.tracer.mark("consensus.commit", height=height,
+                         round=commit_round)
 
         block_id, ok = rs.votes.precommits(commit_round).two_thirds_majority()
         if not ok:
@@ -1114,7 +1137,8 @@ class ConsensusState:
         faults.fail_point("consensus.finalize.save_block")
         if self.block_store.height < block.header.height:
             seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
-            self.block_store.save_block(block, block_parts, seen_commit)
+            with self.tracer.span("consensus.store_save", height=height):
+                self.block_store.save_block(block, block_parts, seen_commit)
 
         # crash site 2 (reference: state.go:1619)
         faults.fail_point("consensus.finalize.end_height")
@@ -1124,11 +1148,12 @@ class ConsensusState:
         # crash site 3 (reference: state.go:1642)
         faults.fail_point("consensus.finalize.apply_block")
         state_copy = self.state.copy()
-        state_copy, retain_height = self.block_exec.apply_block(
-            state_copy,
-            BlockID(hash=block.hash(), part_set_header=block_parts.header()),
-            block,
-        )
+        with self.tracer.span("consensus.abci_apply", height=height):
+            state_copy, retain_height = self.block_exec.apply_block(
+                state_copy,
+                BlockID(hash=block.hash(), part_set_header=block_parts.header()),
+                block,
+            )
 
         # crash site 4 (reference: state.go:1667)
         faults.fail_point("consensus.finalize.prune")
@@ -1165,6 +1190,8 @@ class ConsensusState:
         rs.proposal = proposal
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet.from_header(proposal.block_id.part_set_header)
+        self.tracer.mark("consensus.proposal", height=proposal.height,
+                         round=proposal.round)
 
     def _add_proposal_block_part(self, msg: BlockPartMessage) -> bool:
         """reference: consensus/state.go:1850-1920."""
@@ -1183,6 +1210,9 @@ class ConsensusState:
             raise ConsensusError("total size of proposal block parts exceeds maximum block bytes")
         if rs.proposal_block_parts.is_complete():
             rs.proposal_block = Block.unmarshal(rs.proposal_block_parts.assemble())
+            self.tracer.mark("consensus.block_parts", height=rs.height,
+                             round=rs.round,
+                             parts=rs.proposal_block_parts.header().total)
             self.event_bus.publish_event_complete_proposal(
                 tmevents.EventDataCompleteProposal(
                     height=rs.height, round=rs.round, step=rs.step_name(),

@@ -29,8 +29,10 @@ from __future__ import annotations
 
 import abc
 import os
+import time as _time
 
 from tendermint_tpu.crypto import keys
+from tendermint_tpu.utils import trace as _trace
 
 
 def _device_get(tree):
@@ -52,12 +54,20 @@ class PendingVerify:
     ``resolve()`` is idempotent: the first call fetches and caches, later
     calls return the cached (all_ok, bitmap)."""
 
-    __slots__ = ("_devs", "_resolve", "_result")
+    __slots__ = ("_devs", "_resolve", "_result", "_tracer", "_t_disp",
+                 "_t_height")
 
     def __init__(self, devs, resolve_fn):
         self._devs = list(devs)
         self._resolve = resolve_fn
         self._result: tuple[bool, list[bool]] | None = None
+        # flight-recorder context captured at dispatch (utils/trace.py):
+        # the dispatching node's tracer, the dispatch timestamp (queue-wait
+        # phase = resolve start - dispatch end), and the height context so
+        # phases land on the right timeline even when resolve happens later
+        self._tracer = None
+        self._t_disp = 0.0
+        self._t_height = None
 
     @property
     def resolved(self) -> bool:
@@ -73,13 +83,30 @@ class PendingVerify:
         self._devs = [None] * len(self._devs)
         self._resolve = None
 
+    def _trace_tags(self) -> dict:
+        return {} if self._t_height is None else {"height": self._t_height}
+
     def resolve(self) -> tuple[bool, list[bool]]:
         """Fetch (one _device_get when device outputs are pending) and
         return (all_ok, bitmap)."""
         if self._result is None:
-            fetched = (_device_get(self._devs) if self.has_device_output()
-                       else self._devs)
-            self._finish(fetched)
+            tr = self._tracer
+            if tr is not None and tr.enabled:
+                tags = self._trace_tags()
+                if self._t_disp:
+                    tr.record("verify.queue",
+                              _time.monotonic() - self._t_disp, **tags)
+                if self.has_device_output():
+                    with tr.span("verify.readback", **tags):
+                        fetched = _device_get(self._devs)
+                else:
+                    fetched = self._devs
+                with tr.span("verify.replay", **tags):
+                    self._finish(fetched)
+            else:
+                fetched = (_device_get(self._devs) if self.has_device_output()
+                           else self._devs)
+                self._finish(fetched)
         return self._result
 
 
@@ -93,6 +120,21 @@ def prefetch(pendings) -> None:
     unres = [p for p in pendings if p.has_device_output()]
     if not unres:
         return
+    if _trace.ENABLED:
+        tr = _trace.current()
+        if tr.enabled:
+            now = _time.monotonic()
+            for p in unres:
+                if p._t_disp:
+                    pt = p._tracer if p._tracer is not None else tr
+                    pt.record("verify.queue", now - p._t_disp,
+                              **p._trace_tags())
+            with tr.span("verify.readback", batched=len(unres)):
+                fetched = _device_get([p._devs for p in unres])
+            with tr.span("verify.replay", batched=len(unres)):
+                for p, f in zip(unres, fetched):
+                    p._finish(f)
+            return
     fetched = _device_get([p._devs for p in unres])
     for p, f in zip(unres, fetched):
         p._finish(f)
@@ -215,7 +257,14 @@ class _KernelBatchVerifier(BatchVerifier):
 
         ops = self._module("_ops_module")
         started = _t.monotonic()
-        dev, finish = ops.dispatch_batch(items, force_device=force_device)
+        if _trace.ENABLED:  # flight recorder: host-prep phase attribution
+            tracer = _trace.current()
+            with tracer.span("verify.host_prep", n=len(items)):
+                dev, finish = ops.dispatch_batch(items,
+                                                 force_device=force_device)
+        else:
+            tracer = None
+            dev, finish = ops.dispatch_batch(items, force_device=force_device)
 
         def resolve(fetched):
             out = [bool(b) for b in finish(fetched[0])]
@@ -225,7 +274,12 @@ class _KernelBatchVerifier(BatchVerifier):
                 m.batch_verify_sigs.add(len(items))
             return all(out), out
 
-        return PendingVerify([dev], resolve)
+        p = PendingVerify([dev], resolve)
+        if tracer is not None and tracer.enabled:
+            p._tracer = tracer
+            p._t_disp = _t.monotonic()
+            p._t_height = tracer.current_height()
+        return p
 
     def verify(self) -> tuple[bool, list[bool]]:
         return self.dispatch().resolve()
@@ -297,7 +351,14 @@ class MixedBatchVerifier(BatchVerifier):
             out = [results[kt][i] for (kt, i) in order]
             return all(out), out
 
-        return PendingVerify(devs, resolve)
+        mixed = PendingVerify(devs, resolve)
+        if _trace.ENABLED:
+            tracer = _trace.current()
+            if tracer.enabled:
+                mixed._tracer = tracer
+                mixed._t_disp = _time.monotonic()
+                mixed._t_height = tracer.current_height()
+        return mixed
 
     def verify(self) -> tuple[bool, list[bool]]:
         # Dispatch every key type's kernel first, then fetch ALL results in

@@ -236,7 +236,7 @@ class Cluster:
                  chain_id: str = "fabric-chain", mempool_broadcast: bool = True,
                  durable: bool = False, snapshot_interval: int = 0,
                  rpc_node: int = -1, metrics_node: int = -1, tweak=None,
-                 logger=None):
+                 trace: bool = False, logger=None):
         self.root = str(root)
         self.n_initial = n
         self.topology = topology
@@ -249,6 +249,10 @@ class Cluster:
         self.rpc_node = rpc_node
         self.metrics_node = metrics_node
         self.tweak = tweak
+        # trace=True enables every node's flight recorder (utils/trace.py)
+        # at build time — the soak auditor's stall annotations need the
+        # per-node span ring; TMTPU_TRACE=1 does the same via the env
+        self.trace = trace
         self.logger = logger
         self.nodes: dict[int, FabricNode] = {}
         self._next_idx = 0
@@ -332,6 +336,8 @@ class Cluster:
         node = Node(cfg, app=self._mk_app(), genesis=self._genesis,
                     priv_validator=MockPV(priv), node_key=node_key,
                     logger=self.logger)
+        if self.trace:
+            node.tracer.enable()
         return FabricNode(idx, node, priv, cfg.base.root_dir,
                           joined_via=joined_via)
 

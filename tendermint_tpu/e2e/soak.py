@@ -247,6 +247,35 @@ class ContinuousAuditor:
         self.violations.append(
             Violation(kind, detail, at_s=time.monotonic() - self._t0))
 
+    def _lag_annotation(self) -> str:
+        """Per-lagging-node last-completed phase from each node's flight
+        recorder (utils/trace.py): a liveness violation that says WHICH
+        phase of WHICH height every straggler last finished is a repro
+        line an engineer can act on. Nodes without an enabled tracer
+        annotate as `last_phase=?` (the harness builds clusters with
+        trace=True; a stub or opted-out node degrades gracefully)."""
+        parts = []
+        try:
+            for idx, fn in sorted(self.cluster.nodes.items()):
+                if fn.height >= self._best:
+                    continue
+                tracer = getattr(fn.node, "tracer", None)
+                lp = (tracer.last_phase()
+                      if tracer is not None and getattr(tracer, "enabled",
+                                                        False) else None)
+                if lp is None:
+                    parts.append(f"node {idx}@h{fn.height} last_phase=?")
+                else:
+                    at = (f"(h{lp['height']})"
+                          if lp.get("height") is not None else "")
+                    parts.append(
+                        f"node {idx}@h{fn.height} last_phase={lp['name']}"
+                        f"{at} {lp['age_s']:.1f}s ago")
+        except Exception:  # noqa: BLE001 - annotation must never mask the
+            # violation it decorates (mid-churn teardown races)
+            pass
+        return "; ".join(parts)
+
     def sweep(self) -> None:
         """One audit pass (public so tests and the final drain call it
         synchronously)."""
@@ -297,11 +326,13 @@ class ContinuousAuditor:
               and now - self._last_advance > self.liveness_budget_s
               and not self._stalled_reported):
             self._stalled_reported = True  # once per stall episode
+            lag = self._lag_annotation()
             self._record("liveness",
                          f"no commit cluster-wide for "
                          f"{now - self._last_advance:.1f}s "
                          f"(budget {self.liveness_budget_s:.0f}s) at "
-                         f"height {self._best}")
+                         f"height {self._best}"
+                         + (f" [lagging: {lag}]" if lag else ""))
 
 
 # --- the driver --------------------------------------------------------------
@@ -546,7 +577,10 @@ def run_soak(root: str, seed: int = 1, nodes: int = DEFAULT_NODES,
     cluster = Cluster(
         root, nodes, topology=topology,
         snapshot_interval=4 if statesync_ok else 0,
-        rpc_node=0 if statesync_ok else -1, tweak=tweak, logger=logger)
+        rpc_node=0 if statesync_ok else -1, tweak=tweak,
+        # per-node flight recorders feed the auditor's last-phase stall
+        # annotations; default ON for soaks, TMTPU_TRACE=0 opts out
+        trace=os.environ.get("TMTPU_TRACE", "1") != "0", logger=logger)
     cluster.start()
     try:
         driver = SoakDriver(cluster, schedule, seed, duration_s,

@@ -114,6 +114,9 @@ class Mempool:
         self._txs_available: threading.Event | None = None
         self.pre_check = None   # fn(tx) -> raises ErrPreCheck
         self.post_check = None  # fn(tx, res) -> raises
+        # flight recorder (utils/trace.py): node wiring installs the node's
+        # tracer; None = untraced (standalone mempools, tests)
+        self.tracer = None
 
     # --- Mempool interface (reference: mempool/mempool.go:14-90) -----------
 
@@ -159,7 +162,14 @@ class Mempool:
                     existing.senders.add(sender_peer)
             raise ErrTxInCache()
 
-        res = self.app.check_tx(abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW))
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            with tr.span("mempool.check_tx", bytes=len(tx)):
+                res = self.app.check_tx(
+                    abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW))
+        else:
+            res = self.app.check_tx(
+                abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW))
         if self.post_check is not None:
             try:
                 self.post_check(tx, res)

@@ -295,6 +295,20 @@ class Node:
             self.switch.scoreboard.on_ban.append(
                 lambda pid, until: self.addr_book.mark_bad(pid))
 
+        # flight recorder (utils/trace.py, docs/OBSERVABILITY.md): one
+        # instance-scoped Tracer per node — the module-global ring would
+        # interleave spans from every node of an in-process mesh. Enabled
+        # by TMTPU_TRACE=1 (ring size TMTPU_TRACE_CAP); the fabric/soak
+        # harness and the unsafe_trace RPC route can flip it live.
+        from tendermint_tpu.utils import trace as tmtrace
+
+        self.tracer = tmtrace.Tracer(name=self.node_key.id()[:12],
+                                     enabled=tmtrace.trace_enabled_from_env())
+        self.consensus.tracer = self.tracer
+        self.mempool.tracer = self.tracer
+        self.switch.tracer = self.tracer
+        self.bc_reactor.tracer = self.tracer
+
         self.rpc_server = None
         self._tx_notify_thread = None
 
@@ -419,6 +433,11 @@ class Node:
 
     def stop(self) -> None:
         self._running = False
+        # release the flight recorder's module-wide ENABLED refcount: a
+        # stopped node must not pin every later hot-path guard in this
+        # process on the instrumented branch (fabric churn builds and
+        # stops hundreds of nodes per session)
+        self.tracer.disable()
         self.watchdog.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()

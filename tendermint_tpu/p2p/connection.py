@@ -86,7 +86,7 @@ class MConnection:
                  recv_rate: int = DEFAULT_RECV_RATE,
                  local_id: str = "", remote_id: str = "",
                  msg_rates: dict[int, float] | None = None,
-                 on_rate_limited=None):
+                 on_rate_limited=None, tracer=None):
         self._conn = conn
         # peer-id context for the link-scoped fault plane (utils/nemesis.py):
         # which directed link this connection is, so a partition can cut
@@ -119,6 +119,9 @@ class MConnection:
 
             self._rate_limiter = ChannelRateLimiter(msg_rates)
         self._on_rate_limited = on_rate_limited
+        # flight recorder (utils/trace.py): per-channel send/recv events
+        # land in the owning node's tracer; None = untraced
+        self._tracer = tracer
 
     def start(self) -> None:
         self._running = True
@@ -160,6 +163,9 @@ class MConnection:
             ch.send_queue.put(msg, block=block, timeout=10 if block else None)
         except queue.Full:
             return False
+        tr = self._tracer
+        if tr is not None and tr.enabled:
+            tr.mark("p2p.send", channel=f"{ch_id:#x}", bytes=len(msg))
         if verdict == "dup":
             try:
                 ch.send_queue.put(msg, block=False)
@@ -309,7 +315,17 @@ class MConnection:
                             "p2p.recv", self._local_id, self._remote_id,
                             channel=ch_id)
                         if verdict != "drop":
-                            self._on_receive(ch_id, msg)
+                            tr = self._tracer
+                            if tr is not None and tr.enabled:
+                                # the span times the reactor's receive
+                                # handler — where per-message Python cost
+                                # (the 100-node wall) actually goes
+                                with tr.span("p2p.recv",
+                                             channel=f"{ch_id:#x}",
+                                             bytes=len(msg)):
+                                    self._on_receive(ch_id, msg)
+                            else:
+                                self._on_receive(ch_id, msg)
                             if verdict == "dup":
                                 self._on_receive(ch_id, msg)
                 self._last_recv = time.monotonic()

@@ -69,7 +69,7 @@ class Peer:
                  socket_addr: str = "", send_rate: int = 5_120_000,
                  recv_rate: int = 5_120_000, local_id: str = "",
                  msg_rates: dict[int, float] | None = None,
-                 on_rate_limited=None):
+                 on_rate_limited=None, tracer=None):
         self.node_info = node_info
         self.outbound = outbound
         self.persistent = persistent
@@ -84,6 +84,7 @@ class Peer:
             msg_rates=msg_rates,
             on_rate_limited=(lambda ch: on_rate_limited(self, ch))
             if on_rate_limited is not None else None,
+            tracer=tracer,
         )
 
     @property
@@ -250,6 +251,10 @@ class Switch:
         self._persistent_addrs: list[str] = []
         self._accept_thread: threading.Thread | None = None
         self._reconnect_thread: threading.Thread | None = None
+        # flight recorder (utils/trace.py): node wiring installs the node's
+        # tracer BEFORE start(); every peer connection built afterwards
+        # records its per-channel send/recv events there
+        self.tracer = None
         # Redial backoff state, instance-level so kick_reconnect() can wipe
         # it (a nemesis heal must not wait out the clamped max backoff
         # accumulated while the partition blocked every dial).
@@ -421,7 +426,8 @@ class Switch:
                         send_rate=self.send_rate, recv_rate=self.recv_rate,
                         local_id=self.transport.node_info.node_id,
                         msg_rates=self.msg_rates,
-                        on_rate_limited=self._on_rate_limited)
+                        on_rate_limited=self._on_rate_limited,
+                        tracer=self.tracer)
             self.peers[peer.id] = peer
         # Reactors attach their per-peer state (and queue their hello
         # messages) BEFORE the connection starts reading: bytes the remote

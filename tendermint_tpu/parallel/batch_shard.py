@@ -204,6 +204,17 @@ def dispatch_sharded(kind: str, ks, key_idx, arrays: list, n: int):
     r_limbs, valid). Returns the (Npad,) bool device array without fetching
     (callers batch the readback); the bitmap is byte-identical to the
     single-device path."""
+    from tendermint_tpu.utils import trace as _trace
+
+    if _trace.ENABLED:
+        tr = _trace.current()
+        if tr.enabled:
+            with tr.span("verify.shard_dispatch", kind=kind, n=n):
+                return _dispatch_sharded(kind, ks, key_idx, arrays, n)
+    return _dispatch_sharded(kind, ks, key_idx, arrays, n)
+
+
+def _dispatch_sharded(kind: str, ks, key_idx, arrays: list, n: int):
     import numpy as np
 
     mesh = _get_mesh()

@@ -800,6 +800,47 @@ def unsafe_nemesis(env, partition=None, heal=False, links=None):
     return nemesis.PLANE.describe()
 
 
+def unsafe_trace(env, enable=None, clear=False, dump=False):
+    """Flight-recorder control + summary view (utils/trace.py,
+    docs/OBSERVABILITY.md; no reference analogue — the reference exposes
+    pprof, this build's host-side recorder is span-structured).
+
+    With no params: the tracer's state + per-span-name aggregation.
+    ``enable``: true/false flips this node's tracer live. ``clear`` drops
+    the ring. ``dump=true`` adds the raw span list (ring-bounded)."""
+    _require_unsafe(env)
+    tracer = getattr(env.node, "tracer", None)
+    if tracer is None:
+        raise ValueError("node has no tracer (utils/trace.py not wired)")
+    if enable is not None:
+        if enable in (True, "true", "1", 1):
+            tracer.enable()
+        elif enable in (False, "false", "0", 0):
+            tracer.disable()
+        else:
+            raise ValueError("enable must be a boolean")
+    if clear in (True, "true", "1", 1):
+        tracer.clear()
+    out = dict(tracer.describe())
+    out["summary"] = tracer.summarize()
+    if dump in (True, "true", "1", 1):
+        out["spans"] = [s.as_dict() for s in tracer.dump()]
+    return out
+
+
+def unsafe_timeline(env, height=0):
+    """Structured per-height block-lifecycle timeline from the node's
+    flight recorder (docs/OBSERVABILITY.md schema): lifecycle marks,
+    verify-pipeline phase durations, causal-order verdict. Default
+    height: the latest committed block."""
+    _require_unsafe(env)
+    tracer = getattr(env.node, "tracer", None)
+    if tracer is None:
+        raise ValueError("node has no tracer (utils/trace.py not wired)")
+    h = int(height) or env.node.block_store.height
+    return tracer.timeline(h)
+
+
 ROUTES = {
     "health": health,
     "status": status,
@@ -836,4 +877,6 @@ ROUTES = {
     "unsafe_flush_mempool": unsafe_flush_mempool,
     "unsafe_nemesis": unsafe_nemesis,
     "unsafe_peers": unsafe_peers,
+    "unsafe_trace": unsafe_trace,
+    "unsafe_timeline": unsafe_timeline,
 }

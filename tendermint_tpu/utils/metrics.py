@@ -97,6 +97,17 @@ class Histogram(_Metric):
             self._sums[k] = self._sums.get(k, 0.0) + value
             self._totals[k] = self._totals.get(k, 0) + 1
 
+    def seed(self, **labels) -> None:
+        """Pre-seed a labeled series at zero observations, so a healthy
+        node scrapes explicit `_bucket`/`_sum`/`_count` zeros instead of an
+        absent metric — the histogram twin of the Counter.add(0) discipline
+        (tmlint metrics-discipline)."""
+        k = self._key(labels)
+        with self._mtx:
+            self._counts.setdefault(k, [0] * len(self.buckets))
+            self._sums.setdefault(k, 0.0)
+            self._totals.setdefault(k, 0)
+
     def expose(self) -> list[str]:
         out = []
         with self._mtx:
@@ -183,6 +194,14 @@ class NodeMetrics:
             "consensus", "step_duration_seconds", "Time spent per step.",
             labels=("step",),
             buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 1, 5))
+        # flight-recorder phase mirror (utils/trace.py, docs/OBSERVABILITY
+        # .md): Tracer._append observes every MIRRORED_SPANS span here, so
+        # phase attribution is scrapeable without the TMTPU_TRACE ring
+        self.trace_phase_seconds = r.histogram(
+            "trace", "phase_seconds",
+            "Flight-recorder span durations by phase (utils/trace.py "
+            "MIRRORED_SPANS).", labels=("phase",),
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1))
         self.batch_verify_seconds = r.histogram(
             "consensus", "batch_verify_seconds",
             "Latency of batched signature verification flushes (TPU-path).",
@@ -291,6 +310,20 @@ class NodeMetrics:
         for kernel in ("ed25519", "sr25519"):
             self.breaker_open.set(0.0, kernel=kernel)
             self.breaker_trips.set(0.0, kernel=kernel)
+        # the phase histogram's label universe IS trace.MIRRORED_SPANS:
+        # seed every series so dashboards see zeros, not absence, and the
+        # scrape-shape test can pin the full exposition
+        from tendermint_tpu.utils import trace as _tmtrace
+
+        for phase in _tmtrace.MIRRORED_SPANS:
+            self.trace_phase_seconds.seed(phase=phase)
+        # consensus.step spans mirror into the per-step histogram too
+        # (state_machine tags the step NAME); seed the exact universe the
+        # machine labels with, so a step added to cstypes cannot drift
+        from tendermint_tpu.consensus.cstypes import STEP_NAMES
+
+        for step_name in STEP_NAMES.values():
+            self.step_duration.seed(step=step_name)
 
 
 # Global registry hook for hot paths that have no handle on the node (the

@@ -1,97 +1,402 @@
-"""Tracing/profiling harness (SURVEY aux #36).
+"""Consensus flight recorder: per-node causal span tracing (SURVEY aux #36).
 
 The reference exposes pprof + Prometheus step histograms; a TPU build also
-needs (a) lightweight host-side span tracing around consensus transitions
-and verify flushes, and (b) a JAX device profiler hook for kernel work.
+needs to ATTRIBUTE the ~104 ms host<->device sync floor (ROADMAP item 1):
+of a decision's wall time, how much was host prep, queue wait, device
+compute, readback, and bitmap replay — and WHERE in the block lifecycle a
+stalled node last made progress.
 
- - span(name): context manager recording wall-time spans into a bounded
-   in-memory ring (enable() first; disabled spans cost one dict lookup).
- - jax_profile(dir): wraps jax.profiler.trace when JAX is importable --
-   traces written there open in TensorBoard / xprof.
- - dump(): drain the ring for RPC debug dumps or test assertions.
+Three layers:
+
+ - :class:`Tracer` — an instance-scoped bounded ring of :class:`Span`
+   records. One per Node (``node.tracer``): the old module-global ring
+   interleaved spans from all 50 fabric nodes of an in-process mesh.
+   Spans are CAUSAL: nested ``span()`` regions on one thread link
+   parent/child ids, and a ``height=`` tag set by an enclosing span is
+   inherited by its children (``current_height``), so the deferred verify
+   phases dispatched inside a vote-drain span land on the right height.
+ - the module-level functions: ``span()/mark()/record()`` delegate to the
+   thread's ACTIVE tracer (``Tracer.activate()``), falling back to the
+   process :data:`DEFAULT` tracer; ``dump()/summarize()/enable()`` always
+   address DEFAULT (the pre-flight-recorder API surface — draining a
+   node's ring goes through ``node.tracer``/``unsafe_trace``). Hot call
+   sites guard on the module attribute :data:`ENABLED` (true while ANY
+   tracer is enabled), so the disabled path costs one attribute load
+   (tests/test_trace.py gates this).
+ - consumers: ``Tracer.timeline(height)`` assembles the structured
+   per-height block lifecycle (docs/OBSERVABILITY.md schema; served by the
+   ``unsafe_timeline`` RPC route), ``last_phase()`` feeds the soak
+   auditor's stall annotations, and spans named in :data:`MIRRORED_SPANS`
+   are mirrored into the pre-seeded ``trace_phase_seconds`` histogram.
+
+Knobs: ``TMTPU_TRACE=1`` enables every node's tracer at construction;
+``TMTPU_TRACE_CAP`` sets the per-tracer ring size (default 4096);
+``TMTPU_TRACE_XPROF=<dir>`` makes bench.py wrap its instrumented
+attribution pass in :func:`jax_profile` (TensorBoard/xprof traces).
 """
 
 from __future__ import annotations
 
 import contextlib
+import itertools
+import os
 import threading
 import time
-from collections import deque
 from dataclasses import dataclass
 
-_MAX_SPANS = 4096
+DEFAULT_CAP = 4096
+
+# ---------------------------------------------------------------------------
+# Canonical span table (tmlint rule `trace-span-discipline`): every span
+# name used by trace.span()/mark()/record() in production code must be a
+# key here AND documented in docs/OBSERVABILITY.md — ad-hoc span strings
+# drift from the doc and break timeline/dashboard consumers.
+# ---------------------------------------------------------------------------
+
+CANONICAL_SPANS = {
+    # consensus block lifecycle (marks; once per committed single-round
+    # height — the `unsafe_timeline` LIFECYCLE set, in causal order)
+    "consensus.proposal": "proposal accepted onto the round state",
+    "consensus.block_parts": "proposal part-set completed (block assembled)",
+    "consensus.precommit": "entered the precommit step",
+    "consensus.commit": "entered commit (+2/3 precommits on a block)",
+    "consensus.store_save": "block + seen commit persisted (span)",
+    "consensus.abci_apply": "ABCI BeginBlock..Commit of the decided block (span)",
+    # consensus timing
+    "consensus.step": "time spent in the round step just left",
+    "consensus.vote_drain": "batched peer-vote drain: build + dispatch",
+    # deferred verify pipeline phases (crypto/batch.py; the sync-floor
+    # attribution ROADMAP item 1 needs)
+    "verify.host_prep": "host prep + kernel dispatch (ops dispatch_batch)",
+    "verify.queue": "dispatch()->resolve() queue wait of a PendingVerify",
+    "verify.device": "device compute (bench attribution pass only)",
+    "verify.readback": "blocking D2H fetch (crypto/batch._device_get)",
+    "verify.replay": "bitmap fetch -> serial accept/reject replay",
+    "verify.shard_dispatch": "multi-device shard_map dispatch (parallel/batch_shard)",
+    # fast-sync verify-ahead (blockchain/pipeline.py)
+    "fastsync.dispatch": "speculative commit-verify dispatch for one height",
+    "fastsync.apply": "block save + ABCI apply of a fast-synced height",
+    # tx front door + gossip plane
+    "mempool.check_tx": "ABCI CheckTx round trip of one tx",
+    "p2p.send": "message queued to a peer channel (mark)",
+    "p2p.recv": "message delivered to a reactor (span over on_receive)",
+}
+
+# Spans mirrored into the pre-seeded `trace_phase_seconds{phase=}`
+# histogram (utils/metrics.py NodeMetrics). Bounded label universe by
+# construction — this tuple IS the label set.
+MIRRORED_SPANS = (
+    "verify.host_prep", "verify.queue", "verify.readback", "verify.replay",
+    "verify.shard_dispatch", "consensus.vote_drain", "consensus.store_save",
+    "consensus.abci_apply", "mempool.check_tx",
+)
+_MIRROR_SET = frozenset(MIRRORED_SPANS)
+
+# The deterministic per-committed-height lifecycle marks, in causal order
+# (a healthy single-round height emits each exactly once; the timeline's
+# causal_ok verdict checks first-occurrence order against this).
+LIFECYCLE = (
+    "consensus.proposal", "consensus.block_parts", "consensus.precommit",
+    "consensus.commit", "consensus.store_save", "consensus.abci_apply",
+)
+
+
+def trace_cap(default: int = DEFAULT_CAP) -> int:
+    """Per-tracer ring capacity; TMTPU_TRACE_CAP overrides."""
+    v = os.environ.get("TMTPU_TRACE_CAP")
+    try:
+        return max(16, int(v)) if v else default
+    except ValueError:
+        return default
+
+
+def trace_enabled_from_env() -> bool:
+    """TMTPU_TRACE=1: nodes enable their tracer at construction."""
+    return os.environ.get("TMTPU_TRACE") == "1"
 
 
 @dataclass
 class Span:
     name: str
-    start: float
+    start: float        # time.monotonic() at entry
     duration_s: float
     tags: dict
+    span_id: int = 0
+    parent_id: int = 0  # 0 = root (no enclosing span on that thread)
+
+    def as_dict(self) -> dict:
+        return {"name": self.name, "start": self.start,
+                "duration_s": self.duration_s, "span_id": self.span_id,
+                "parent_id": self.parent_id, "tags": dict(self.tags)}
 
 
-_enabled = False
-_spans: deque = deque(maxlen=_MAX_SPANS)
-_mtx = threading.Lock()
+# ANY tracer enabled — THE one-attribute-load guard hot call sites check
+# before building a span. Maintained by Tracer.enable()/disable().
+ENABLED = False
+_enabled_count = 0
+_state_mtx = threading.Lock()
+
+# thread-local active tracer (Tracer.activate()); current() falls back to
+# the process DEFAULT so the module-level API keeps its old semantics
+_tl = threading.local()
+
+
+class Tracer:
+    """One bounded span ring + causality bookkeeping. Thread-safe: spans
+    may complete on any thread; parent/height context is per-thread."""
+
+    def __init__(self, name: str = "", cap: int | None = None,
+                 enabled: bool = False):
+        self.name = name
+        self.enabled = False
+        self.cap = cap if cap is not None else trace_cap()
+        from collections import deque
+
+        self._spans: "deque[Span]" = deque(maxlen=self.cap)
+        self._mtx = threading.Lock()
+        self._seq = itertools.count(1)
+        self._ctx = threading.local()  # per-thread parent/height stacks
+        if enabled:
+            self.enable()
+
+    # --- enable/disable (keeps the module ENABLED guard honest) ------------
+
+    def enable(self) -> None:
+        global ENABLED, _enabled_count
+        with _state_mtx:
+            if not self.enabled:
+                self.enabled = True
+                _enabled_count += 1
+                ENABLED = True
+
+    def disable(self) -> None:
+        global ENABLED, _enabled_count
+        with _state_mtx:
+            if self.enabled:
+                self.enabled = False
+                _enabled_count -= 1
+                ENABLED = _enabled_count > 0
+
+    # --- thread-local activation -------------------------------------------
+
+    @contextlib.contextmanager
+    def activate(self):
+        """Make this tracer the thread's `current()` target, so library
+        layers (crypto/batch, parallel/batch_shard) record into the node
+        whose work they are doing without constructor plumbing."""
+        prev = getattr(_tl, "tracer", None)
+        _tl.tracer = self
+        try:
+            yield self
+        finally:
+            _tl.tracer = prev
+
+    # --- recording ----------------------------------------------------------
+
+    def _stacks(self):
+        c = self._ctx
+        if not hasattr(c, "parents"):
+            c.parents = []
+            c.heights = []
+        return c
+
+    def current_height(self):
+        """Innermost height= tag of the enclosing span stack, or None."""
+        c = self._stacks()
+        return c.heights[-1] if c.heights else None
+
+    @contextlib.contextmanager
+    def span(self, name: str, **tags):
+        """Timed causal region. Children started on this thread inside the
+        region get this span as parent and inherit its height tag."""
+        if not self.enabled:
+            yield 0
+            return
+        c = self._stacks()
+        sid = next(self._seq)
+        h = tags.get("height")
+        if h is None and c.heights:
+            tags["height"] = h = c.heights[-1]
+        parent = c.parents[-1] if c.parents else 0
+        c.parents.append(sid)
+        if h is not None:
+            c.heights.append(h)
+        t0 = time.monotonic()
+        try:
+            yield sid
+        finally:
+            dur = time.monotonic() - t0
+            c.parents.pop()
+            if h is not None:
+                c.heights.pop()
+            self._append(Span(name, t0, dur, tags, sid, parent))
+
+    def mark(self, name: str, **tags) -> None:
+        """Zero-duration lifecycle event."""
+        if not self.enabled:
+            return
+        c = self._stacks()
+        if "height" not in tags and c.heights:
+            tags["height"] = c.heights[-1]
+        parent = c.parents[-1] if c.parents else 0
+        self._append(Span(name, time.monotonic(), 0.0, tags,
+                          next(self._seq), parent))
+
+    def record(self, name: str, duration_s: float, **tags) -> None:
+        """An externally-timed span (e.g. a queue wait measured between
+        two events)."""
+        if not self.enabled:
+            return
+        c = self._stacks()
+        if "height" not in tags and c.heights:
+            tags["height"] = c.heights[-1]
+        parent = c.parents[-1] if c.parents else 0
+        self._append(Span(name, time.monotonic() - duration_s, duration_s,
+                          tags, next(self._seq), parent))
+
+    def _append(self, s: Span) -> None:
+        with self._mtx:
+            self._spans.append(s)
+        if s.name in _MIRROR_SET or s.name == "consensus.step":
+            # metric mirror OUTSIDE the ring lock (lock-held-call
+            # discipline); lazy import breaks the metrics<->trace cycle
+            from tendermint_tpu.utils import metrics as tmmetrics
+
+            m = tmmetrics.GLOBAL_NODE_METRICS
+            if m is None:
+                return
+            if s.name == "consensus.step":
+                # the per-step histogram the reference ships
+                # (consensus/metrics.go StepDuration); step tag = step name
+                m.step_duration.observe(s.duration_s,
+                                        step=str(s.tags.get("step", "")))
+            else:
+                m.trace_phase_seconds.observe(s.duration_s, phase=s.name)
+
+    # --- draining ------------------------------------------------------------
+
+    def dump(self, clear: bool = False) -> list[Span]:
+        with self._mtx:
+            out = list(self._spans)
+            if clear:
+                self._spans.clear()
+        return out
+
+    def clear(self) -> None:
+        with self._mtx:
+            self._spans.clear()
+
+    # deliberately NO __len__: an empty ring must not make the tracer
+    # falsy (`tracer or DEFAULT` fallbacks would silently misroute spans)
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._spans)
+
+    def summarize(self) -> dict[str, dict]:
+        """name -> {count, total_s, max_s} aggregation."""
+        agg: dict[str, dict] = {}
+        for s in self.dump():
+            a = agg.setdefault(s.name, {"count": 0, "total_s": 0.0,
+                                        "max_s": 0.0})
+            a["count"] += 1
+            a["total_s"] += s.duration_s
+            a["max_s"] = max(a["max_s"], s.duration_s)
+        return agg
+
+    def last_phase(self) -> dict | None:
+        """The most recently COMPLETED span — what a stalled node was last
+        able to finish (the soak auditor's stall annotation)."""
+        with self._mtx:
+            if not self._spans:
+                return None
+            s = self._spans[-1]
+        return {"name": s.name, "height": s.tags.get("height"),
+                "round": s.tags.get("round"),
+                "age_s": max(0.0, time.monotonic() - (s.start + s.duration_s))}
+
+    def timeline(self, height: int) -> dict:
+        """The structured per-height lifecycle (docs/OBSERVABILITY.md):
+        every span tagged with this height, start-ordered, plus the
+        LIFECYCLE mark census and a causal-order verdict."""
+        spans = [s for s in self.dump() if s.tags.get("height") == height]
+        spans.sort(key=lambda s: (s.start, s.span_id))
+        counts: dict[str, int] = {}
+        first_start: dict[str, float] = {}
+        phases: dict[str, dict] = {}
+        for s in spans:
+            counts[s.name] = counts.get(s.name, 0) + 1
+            first_start.setdefault(s.name, s.start)
+            p = phases.setdefault(s.name, {"count": 0, "total_s": 0.0})
+            p["count"] += 1
+            p["total_s"] += s.duration_s
+        present = [n for n in LIFECYCLE if n in counts]
+        starts = [first_start[n] for n in present]
+        causal_ok = all(a <= b for a, b in zip(starts, starts[1:]))
+        return {
+            "node": self.name,
+            "height": height,
+            "spans": [s.as_dict() for s in spans],
+            "lifecycle": {n: counts.get(n, 0) for n in LIFECYCLE},
+            "lifecycle_complete": len(present) == len(LIFECYCLE),
+            "causal_ok": causal_ok,
+            "phases": phases,
+        }
+
+    def describe(self) -> dict:
+        return {"name": self.name, "enabled": self.enabled, "cap": self.cap,
+                "spans": self.size()}
+
+
+# The process-default tracer: the module-level API's fallback target, and
+# what standalone harnesses (bench, tests) use without building a Node.
+DEFAULT = Tracer(name="default")
+
+
+def current() -> Tracer:
+    """The thread's active tracer (Tracer.activate()), else DEFAULT."""
+    t = getattr(_tl, "tracer", None)
+    return DEFAULT if t is None else t
+
+
+# --- module-level delegates (the pre-flight-recorder API surface) -----------
 
 
 def enable() -> None:
-    global _enabled
-    _enabled = True
+    DEFAULT.enable()
 
 
 def disable() -> None:
-    global _enabled
-    _enabled = False
+    DEFAULT.disable()
 
 
 def enabled() -> bool:
-    return _enabled
+    return DEFAULT.enabled
 
 
-@contextlib.contextmanager
 def span(name: str, **tags):
-    if not _enabled:
-        yield
-        return
-    t0 = time.monotonic()
-    try:
-        yield
-    finally:
-        with _mtx:
-            _spans.append(Span(name, t0, time.monotonic() - t0, tags))
+    return current().span(name, **tags)
+
+
+def mark(name: str, **tags) -> None:
+    current().mark(name, **tags)
 
 
 def record(name: str, duration_s: float, **tags) -> None:
-    """Record an externally-timed span (e.g. a kernel wall time)."""
-    if not _enabled:
-        return
-    with _mtx:
-        _spans.append(Span(name, time.monotonic() - duration_s, duration_s, tags))
+    current().record(name, duration_s, **tags)
 
 
 def dump(clear: bool = False) -> list[Span]:
-    with _mtx:
-        out = list(_spans)
-        if clear:
-            _spans.clear()
-    return out
+    return DEFAULT.dump(clear=clear)
 
 
 def summarize() -> dict[str, dict]:
-    """name -> {count, total_s, max_s} aggregation."""
-    agg: dict[str, dict] = {}
-    for s in dump():
-        a = agg.setdefault(s.name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
-        a["count"] += 1
-        a["total_s"] += s.duration_s
-        a["max_s"] = max(a["max_s"], s.duration_s)
-    return agg
+    return DEFAULT.summarize()
 
 
 @contextlib.contextmanager
 def jax_profile(log_dir: str):
-    """Device-side profiling via jax.profiler (xprof traces)."""
+    """Device-side profiling via jax.profiler (xprof traces; open the
+    written directory in TensorBoard — recipe in docs/OBSERVABILITY.md)."""
     import jax
 
     with jax.profiler.trace(log_dir):

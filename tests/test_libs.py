@@ -154,33 +154,48 @@ def test_fuzzed_connection_faults():
 
 
 def test_trace_spans_and_summary():
+    """Module-level span()/dump() are thin delegates to the process
+    DEFAULT tracer (ISSUE 10 satellite 1) — but the assertions run on an
+    INSTANCE tracer, so they no longer depend on global reset order."""
     from tendermint_tpu.utils import trace
 
-    trace.disable()
-    with trace.span("noop"):
+    t = trace.Tracer("libs-unit")
+    with t.span("noop"):
         pass
-    assert trace.dump(clear=True) == []
+    assert t.dump(clear=True) == []
 
-    trace.enable()
+    t.enable()
     try:
-        with trace.span("verify", batch=64):
+        with t.span("verify", batch=64):
             time.sleep(0.01)
-        trace.record("kernel", 0.005, chunk=0)
-        spans = trace.dump()
+        t.record("kernel", 0.005, chunk=0)
+        spans = t.dump()
         names = [s.name for s in spans]
         assert "verify" in names and "kernel" in names
         v = next(s for s in spans if s.name == "verify")
         assert v.duration_s >= 0.01 and v.tags == {"batch": 64}
-        agg = trace.summarize()
+        agg = t.summarize()
         assert agg["verify"]["count"] == 1
         assert agg["kernel"]["total_s"] >= 0.005
+    finally:
+        t.disable()
+
+    # the module surface still delegates: enable() flips DEFAULT, span()
+    # records into the thread's current tracer (DEFAULT when none active)
+    trace.enable()
+    try:
+        with trace.span("module_delegate"):
+            pass
+        assert any(s.name == "module_delegate" for s in trace.dump())
     finally:
         trace.disable()
         trace.dump(clear=True)
 
 
-def test_trace_consensus_steps(tmp_path):
-    """trace.enable() captures consensus step transitions on a live node."""
+def test_trace_consensus_steps(tmp_path, monkeypatch):
+    """TMTPU_TRACE=1 gives the node an ENABLED instance tracer that
+    captures step transitions and a complete per-height lifecycle —
+    without touching any process-global ring."""
     import os
     from tendermint_tpu.config.config import test_config
     from tendermint_tpu.crypto import ed25519
@@ -191,6 +206,7 @@ def test_trace_consensus_steps(tmp_path):
     from tendermint_tpu.types.ttime import Time
     from tendermint_tpu.utils import trace
 
+    monkeypatch.setenv("TMTPU_TRACE", "1")
     priv = ed25519.gen_priv_key(b"\x43" * 32)
     genesis = GenesisDoc(chain_id="trace-chain", genesis_time=Time(1700003000, 0),
                          validators=[GenesisValidator(b"", priv.pub_key(), 10)])
@@ -202,21 +218,26 @@ def test_trace_consensus_steps(tmp_path):
     cfg.p2p.pex = False
     cfg.rpc.laddr = ""
     cfg.consensus.wal_path = ""
-    trace.enable()
     node = Node(cfg, genesis=genesis, priv_validator=MockPV(priv),
                 node_key=NodeKey(ed25519.gen_priv_key(b"\x44" * 32)))
+    assert node.tracer.enabled  # TMTPU_TRACE=1 wired it on
     node.start()
     try:
         deadline = time.monotonic() + 30
-        while time.monotonic() < deadline and node.block_store.height < 2:
+        while time.monotonic() < deadline and node.block_store.height < 3:
             time.sleep(0.1)
-        assert node.block_store.height >= 2
+        assert node.block_store.height >= 3
     finally:
         node.stop()
-        trace.disable()
-    agg = trace.summarize()
-    trace.dump(clear=True)
+        node.tracer.disable()
+    agg = node.tracer.summarize()
     assert agg.get("consensus.step", {}).get("count", 0) >= 5
+    # the DEFAULT ring stayed out of it: per-node spans are instance-scoped
+    assert not any(s.name == "consensus.step" for s in trace.DEFAULT.dump())
+    # a committed height carries the full lifecycle in causal order
+    tl = node.tracer.timeline(2)
+    assert tl["lifecycle_complete"] and tl["causal_ok"], tl["lifecycle"]
+    assert all(n == 1 for n in tl["lifecycle"].values()), tl["lifecycle"]
 
 
 def test_behaviour_reporter():

@@ -70,11 +70,11 @@ def _rules_of(findings):
 
 
 def test_rule_registry_has_the_contracted_set():
-    assert len(core.RULES) >= 8
+    assert len(core.RULES) >= 9
     assert set(core.RULES) >= {
         "lock-held-call", "lock-order", "device-sync-choke-point",
         "thread-crash-surface", "daemon-or-joined", "metrics-discipline",
-        "fault-site-registry", "config-knob-parity",
+        "fault-site-registry", "trace-span-discipline", "config-knob-parity",
     }
 
 
@@ -293,6 +293,45 @@ def test_fault_site_registry_fixture(tmp_path):
     assert len(fs) == 2, [f.render() for f in fs]
     assert "p2p.made_up" in msgs[0] or "p2p.made_up" in msgs[1]
     assert any("stale_doc_site" in m for m in msgs)
+
+
+_TRACE_FIXTURE = (
+    "CANONICAL_SPANS = {\n"
+    "    'consensus.commit': 'entered commit',\n"
+    "    'verify.readback': 'blocking D2H fetch',\n"
+    "}\n"
+)
+
+
+def test_trace_span_discipline_fixture(tmp_path):
+    """must-trigger: an undeclared span literal, an undocumented
+    canonical span, a stale doc token; must-not: a declared+documented
+    span, a non-dotted literal (peerscore offences etc.), a foreign
+    namespace in the doc."""
+    files = {
+        "tendermint_tpu/utils/trace.py": _TRACE_FIXTURE,
+        "tendermint_tpu/m.py": (
+            "def f(tr, board):\n"
+            "    tr.mark('consensus.commit')\n"
+            "    with tr.span('verify.made_up'):\n"
+            "        pass\n"
+            "    tr.record('verify.queue_typo', 1.0)\n"
+            "    board.record('peerid', 'invalid_signature')\n"
+        ),
+    }
+    side = {"docs/OBSERVABILITY.md": (
+        "`consensus.commit` is documented; `verify.stale_doc_span` is "
+        "stale; `other.namespace` is foreign\n")}
+    fs = _run(tmp_path, files, ["trace-span-discipline"], side)
+    msgs = sorted(f.message for f in fs)
+    assert len(fs) == 4, [f.render() for f in fs]
+    assert any("verify.made_up" in m for m in msgs)
+    assert any("verify.queue_typo" in m for m in msgs)
+    assert any("verify.readback" in m and "not documented" in m
+               for m in msgs)
+    assert any("stale_doc_span" in m for m in msgs)
+    assert not any("invalid_signature" in m or "other.namespace" in m
+                   for m in msgs)
 
 
 def test_config_knob_parity_fixture(tmp_path):

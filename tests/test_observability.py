@@ -103,6 +103,48 @@ import pytest
 
 
 @pytest.mark.quick
+def test_trace_phase_histogram_preseeded_and_mirrored():
+    """ISSUE 10 satellite 2: the flight-recorder phase histogram is
+    pre-seeded for the whole MIRRORED_SPANS label universe (explicit
+    `_bucket`/`_sum`/`_count` zeros — dashboards alert on absence), the
+    step_duration histogram for the 8 step names, and a recorded span
+    flows through the mirror into the same exposition."""
+    from tendermint_tpu.utils import metrics as tmmetrics
+    from tendermint_tpu.utils import trace as tmtrace
+
+    m = tmmetrics.NodeMetrics()
+    text = m.registry.expose()
+    for phase in tmtrace.MIRRORED_SPANS:
+        assert (f'tendermint_trace_phase_seconds_count{{phase="{phase}"}} 0'
+                in text), phase
+        assert (f'tendermint_trace_phase_seconds_sum{{phase="{phase}"}} 0.0'
+                in text), phase
+    assert ('tendermint_trace_phase_seconds_bucket{phase="verify.readback",'
+            'le="+Inf"} 0') in text
+    assert ('tendermint_trace_phase_seconds_bucket{phase="verify.readback",'
+            'le="0.001"} 0') in text
+    assert ('tendermint_consensus_step_duration_seconds_count'
+            '{step="RoundStepCommit"} 0') in text
+
+    tmmetrics.GLOBAL_NODE_METRICS = m
+    t = tmtrace.Tracer("obs-mirror", enabled=True)
+    try:
+        t.record("verify.host_prep", 0.003, height=1)
+        with t.span("mempool.check_tx", bytes=10):
+            pass
+        text = m.registry.expose()
+        assert ('tendermint_trace_phase_seconds_count'
+                '{phase="verify.host_prep"} 1') in text
+        assert ('tendermint_trace_phase_seconds_count'
+                '{phase="mempool.check_tx"} 1') in text
+        assert ('tendermint_trace_phase_seconds_bucket'
+                '{phase="verify.host_prep",le="0.005"} 1') in text
+    finally:
+        t.disable()
+        tmmetrics.GLOBAL_NODE_METRICS = None
+
+
+@pytest.mark.quick
 def test_overload_counters_preseeded_in_exposition():
     """ISSUE 5 satellite 5: the overload-resilience counters (docs/
     OVERLOAD.md) are pre-seeded at 0 so a healthy node scrapes explicit
@@ -335,6 +377,12 @@ def test_localnet_metrics_and_tx_search(tmp_path):
         assert "tendermint_p2p_peers_banned_total" in text
         assert 'tendermint_p2p_shed_total{channel="vote"}' in text
         assert "tendermint_p2p_rate_limited_total" in text
+        # ISSUE 10: the flight-recorder phase histogram rides the same
+        # scrape, pre-seeded for the whole mirrored-span label universe
+        assert ('tendermint_trace_phase_seconds_count'
+                '{phase="verify.readback"}') in text
+        assert ('tendermint_trace_phase_seconds_bucket'
+                '{phase="consensus.abci_apply",le="+Inf"}') in text
     finally:
         node.stop()
         from tendermint_tpu.utils import metrics as tmmetrics

@@ -179,6 +179,34 @@ def test_auditor_liveness_bound_and_expected_stalls():
     assert [v.kind for v in a.violations] == ["liveness", "liveness"]
 
 
+def test_liveness_violation_names_lagging_nodes_last_phase():
+    """ISSUE 10 satellite 4: a stalled node's violation line names the
+    last phase it completed (read from its flight recorder); nodes
+    without an enabled tracer degrade to `last_phase=?`."""
+    from tendermint_tpu.utils import trace
+
+    c = _StubCluster(3)
+    a = soak.ContinuousAuditor(c, liveness_budget_s=0.1)
+    # node 0 leads; node 1 carries a tracer mid-precommit; node 2 has none
+    c.commit(0, 3, b"\x01" * 32)
+    tr = trace.Tracer("stub-lag", enabled=True)
+    try:
+        tr.mark("consensus.precommit", height=4, round=0)
+        c.nodes[1].node.tracer = tr
+        a._t0 = a._last_advance = time.monotonic()
+        a.sweep()
+        assert not a.violations
+        time.sleep(0.25)
+        a.sweep()
+        assert [v.kind for v in a.violations] == ["liveness"]
+        detail = a.violations[0].detail
+        assert "lagging:" in detail, detail
+        assert "node 1@h0 last_phase=consensus.precommit(h4)" in detail, detail
+        assert "node 2@h0 last_phase=?" in detail, detail
+    finally:
+        tr.disable()
+
+
 # ---------------------------------------------------------------------------
 # Driven soaks
 # ---------------------------------------------------------------------------

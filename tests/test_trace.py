@@ -1,0 +1,332 @@
+"""ISSUE 10: the consensus flight recorder (utils/trace.py,
+docs/OBSERVABILITY.md).
+
+Four layers:
+
+1. Tracer units: instance isolation (no cross-node interleaving), causal
+   parent/child linkage + height inheritance, ring bounds, thread safety.
+2. THE disabled-cost gate: with tracing off, instrumented paths must not
+   touch the ring, and the hot-site guard (one attribute load) must stay
+   ~free — this is what lets the spans live on per-message paths.
+3. Timeline semantics: lifecycle census, causal-order verdict, phase
+   aggregation, last_phase.
+4. A 3-node fabric mesh smoke: a committed height's timeline contains
+   every lifecycle phase exactly once, served over the unsafe_timeline
+   RPC route.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.utils import trace
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture
+def tracer():
+    t = trace.Tracer("t-unit", cap=256, enabled=True)
+    yield t
+    t.disable()
+
+
+# ---------------------------------------------------------------------------
+# 1. tracer units
+# ---------------------------------------------------------------------------
+
+
+def test_instance_isolation_no_interleaving():
+    """Two tracers (two fabric nodes) never see each other's spans, and
+    neither pollutes the process DEFAULT ring."""
+    before_default = len(trace.DEFAULT.dump())
+    a = trace.Tracer("nodeA", enabled=True)
+    b = trace.Tracer("nodeB", enabled=True)
+    try:
+        a.mark("consensus.commit", height=1)
+        b.mark("consensus.proposal", height=2)
+        with a.activate():
+            trace.mark("consensus.precommit", height=1)
+        assert [s.name for s in a.dump()] == ["consensus.commit",
+                                              "consensus.precommit"]
+        assert [s.name for s in b.dump()] == ["consensus.proposal"]
+        assert len(trace.DEFAULT.dump()) == before_default
+    finally:
+        a.disable()
+        b.disable()
+
+
+def test_causal_parent_child_and_height_inheritance(tracer):
+    with tracer.span("consensus.vote_drain", height=9, votes=3) as outer:
+        with tracer.span("verify.host_prep", n=64) as inner:
+            pass
+        tracer.record("verify.queue", 0.002)
+        tracer.mark("consensus.precommit")
+        assert tracer.current_height() == 9
+    assert tracer.current_height() is None
+    by_name = {s.name: s for s in tracer.dump()}
+    drain = by_name["consensus.vote_drain"]
+    assert drain.span_id == outer and drain.parent_id == 0
+    assert by_name["verify.host_prep"].span_id == inner
+    # causality: children link the enclosing span and inherit its height
+    for child in ("verify.host_prep", "verify.queue", "consensus.precommit"):
+        assert by_name[child].parent_id == drain.span_id, child
+        assert by_name[child].tags["height"] == 9, child
+    # explicit height beats inheritance
+    with tracer.span("fastsync.dispatch", height=5):
+        tracer.mark("fastsync.apply", height=6)
+    assert {s.tags["height"] for s in tracer.dump()
+            if s.name == "fastsync.apply"} == {6}
+
+
+def test_ring_bound_evicts_oldest():
+    t = trace.Tracer("ring", cap=16, enabled=True)
+    try:
+        for i in range(100):
+            t.mark("consensus.commit", height=i)
+        spans = t.dump()
+        assert len(spans) == 16 and t.size() == 16
+        assert [s.tags["height"] for s in spans] == list(range(84, 100))
+    finally:
+        t.disable()
+
+
+def test_trace_cap_env_knob(monkeypatch):
+    monkeypatch.setenv("TMTPU_TRACE_CAP", "32")
+    assert trace.Tracer("capped").cap == 32
+    monkeypatch.setenv("TMTPU_TRACE_CAP", "bogus")
+    assert trace.Tracer("fallback").cap == trace.DEFAULT_CAP
+
+
+def test_thread_safety_concurrent_recording():
+    t = trace.Tracer("mt", cap=8192, enabled=True)
+    errs = []
+
+    def worker(tid):
+        try:
+            for i in range(200):
+                with t.span("consensus.vote_drain", height=tid):
+                    t.mark("consensus.commit")
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    t.disable()
+    assert not errs
+    spans = t.dump()
+    assert len(spans) == 8 * 200 * 2
+    # per-thread parent stacks never crossed: every mark's parent is a
+    # drain span carrying the SAME thread's height tag
+    drains = {s.span_id: s for s in spans
+              if s.name == "consensus.vote_drain"}
+    for s in spans:
+        if s.name == "consensus.commit":
+            assert s.parent_id in drains
+            assert drains[s.parent_id].tags["height"] == s.tags["height"]
+
+
+# ---------------------------------------------------------------------------
+# 2. the disabled-cost quick gate
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_path_records_nothing_and_stays_cheap():
+    """ISSUE 10 acceptance: disabled tracing costs one attribute load at
+    the hot sites. Structural half: nothing touches the ring. Timing
+    half: the guard pattern stays within an order of magnitude of a bare
+    loop (generous bound — this catches an accidental lock/allocation on
+    the disabled path, not micro-regressions)."""
+    t = trace.Tracer("gate")  # disabled
+    with t.span("consensus.vote_drain", height=1):
+        pass
+    t.mark("consensus.commit")
+    t.record("verify.queue", 0.1)
+    assert t.dump() == [] and not t.enabled
+
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        if t.enabled:  # the documented hot-site guard
+            raise AssertionError
+    guard_s = time.perf_counter() - t0
+    assert guard_s / n < 2e-6, f"{guard_s / n * 1e9:.0f} ns/guard"
+
+
+def test_enabled_refcount_maintains_module_guard():
+    base = trace.ENABLED
+    a = trace.Tracer("ra")
+    b = trace.Tracer("rb")
+    a.enable()
+    b.enable()
+    assert trace.ENABLED
+    a.disable()
+    assert trace.ENABLED  # b still on
+    a.disable()  # idempotent: must not underflow the refcount
+    assert trace.ENABLED
+    b.disable()
+    assert trace.ENABLED == base
+
+
+# ---------------------------------------------------------------------------
+# 3. timeline / last_phase / metrics mirror
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_lifecycle_census_and_causal_order(tracer):
+    for name in trace.LIFECYCLE:
+        tracer.mark(name, height=7, round=0)
+    tracer.mark("consensus.proposal", height=8)  # other height: filtered
+    tl = tracer.timeline(7)
+    assert tl["lifecycle_complete"] and tl["causal_ok"]
+    assert all(n == 1 for n in tl["lifecycle"].values())
+    assert all(s["tags"]["height"] == 7 for s in tl["spans"])
+
+    # out-of-order lifecycle (commit observed before proposal) is flagged
+    t2 = trace.Tracer("ooo", enabled=True)
+    try:
+        t2.mark("consensus.commit", height=3)
+        t2.mark("consensus.proposal", height=3)
+        tl2 = t2.timeline(3)
+        assert not tl2["causal_ok"] and not tl2["lifecycle_complete"]
+    finally:
+        t2.disable()
+
+
+def test_timeline_phase_aggregation(tracer):
+    with tracer.span("consensus.vote_drain", height=4):
+        tracer.record("verify.queue", 0.25)
+        tracer.record("verify.queue", 0.25)
+    ph = tracer.timeline(4)["phases"]
+    assert ph["verify.queue"]["count"] == 2
+    assert ph["verify.queue"]["total_s"] == pytest.approx(0.5)
+
+
+def test_last_phase_names_most_recent_completion(tracer):
+    assert tracer.last_phase() is None
+    tracer.mark("consensus.precommit", height=12, round=1)
+    lp = tracer.last_phase()
+    assert lp["name"] == "consensus.precommit"
+    assert lp["height"] == 12 and lp["round"] == 1
+    assert lp["age_s"] >= 0.0
+
+
+def test_metrics_mirror_phase_and_step_histograms(tracer):
+    from tendermint_tpu.utils import metrics as tmmetrics
+
+    m = tmmetrics.NodeMetrics()
+    text = m.registry.expose()
+    # pre-seeded: every mirrored phase scrapes explicit zeros, with the
+    # full histogram exposition (satellite 2)
+    for phase in trace.MIRRORED_SPANS:
+        assert (f'tendermint_trace_phase_seconds_count{{phase="{phase}"}} 0'
+                in text), phase
+    assert ('tendermint_trace_phase_seconds_bucket{phase="verify.readback"'
+            ',le="+Inf"} 0') in text
+    assert ('tendermint_trace_phase_seconds_sum{phase="verify.readback"} 0.0'
+            in text)
+    assert ('tendermint_consensus_step_duration_seconds_count'
+            '{step="RoundStepPropose"} 0') in text
+    tmmetrics.GLOBAL_NODE_METRICS = m
+    try:
+        tracer.record("verify.readback", 0.02, height=1)
+        tracer.record("consensus.step", 0.01, step="RoundStepPropose")
+        text = m.registry.expose()
+        assert ('tendermint_trace_phase_seconds_count'
+                '{phase="verify.readback"} 1') in text
+        assert ('tendermint_consensus_step_duration_seconds_count'
+                '{step="RoundStepPropose"} 1') in text
+    finally:
+        tmmetrics.GLOBAL_NODE_METRICS = None
+
+
+def test_pending_verify_spans_via_production_dispatch(tracer):
+    """The crypto-layer phases fire through the real dispatch()/resolve()
+    contract and inherit the drain height captured at dispatch time."""
+    from tendermint_tpu.crypto import batch as crypto_batch
+    from tendermint_tpu.crypto import ed25519
+
+    priv = ed25519.gen_priv_key(b"\x77" * 32)
+    pub = priv.pub_key()
+    items = [(pub, b"m%d" % i, ed25519.sign(priv.data, b"m%d" % i))
+             for i in range(64)]
+    with tracer.activate():
+        with tracer.span("consensus.vote_drain", height=21, votes=64):
+            v = crypto_batch.create_batch_verifier("ed25519")
+            for p, msg, sig in items:
+                v.add(p, msg, sig)
+            pending = v.dispatch()
+        ok, bitmap = pending.resolve()
+    assert ok and all(bitmap)
+    agg = tracer.summarize()
+    assert agg.get("verify.host_prep", {}).get("count") == 1
+    # queue wait recorded between dispatch and resolve, on the height
+    # captured at dispatch
+    queue_spans = [s for s in tracer.dump() if s.name == "verify.queue"]
+    assert queue_spans and queue_spans[0].tags.get("height") == 21
+
+
+# ---------------------------------------------------------------------------
+# 4. 3-node mesh smoke: the committed-height timeline end to end
+# ---------------------------------------------------------------------------
+
+
+def _rpc(base: str, method: str, params: dict):
+    body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                       "params": params}).encode()
+    with urllib.request.urlopen(urllib.request.Request(
+            base, data=body, headers={"Content-Type": "application/json"}),
+            timeout=10) as r:
+        doc = json.loads(r.read())
+    assert "error" not in doc, doc
+    return doc["result"]
+
+
+def test_three_node_mesh_timeline_smoke(tmp_path):
+    """Satellite 4 + acceptance: a committed height's timeline contains
+    every lifecycle phase exactly once, in causal order, on every node —
+    and the unsafe_timeline/unsafe_trace RPC routes serve it."""
+    from tendermint_tpu.e2e.fabric import Cluster
+
+    cluster = Cluster(str(tmp_path), 3, topology="full", rpc_node=0,
+                      trace=True)
+    cluster.start()
+    try:
+        assert cluster.wait_min_height(4, timeout=120), cluster.heights()
+        floor = cluster.min_height()
+        # scan recent fully-committed heights (newest first: ring-eviction
+        # safe) for one every node saw in a single round
+        found = None
+        for h in range(floor - 1, 1, -1):
+            tls = [cluster.nodes[i].node.tracer.timeline(h) for i in (0, 1, 2)]
+            if all(tl["lifecycle_complete"] and tl["causal_ok"]
+                   and all(n == 1 for n in tl["lifecycle"].values())
+                   for tl in tls):
+                found = h
+                break
+        assert found is not None, {
+            i: cluster.nodes[i].node.tracer.timeline(floor - 1)["lifecycle"]
+            for i in (0, 1, 2)}
+
+        # the RPC surface: unsafe_timeline serves the same structure
+        rpc = cluster.nodes[0].node.rpc_server
+        base = "http://" + rpc.laddr.split("://", 1)[1]
+        tl = _rpc(base, "unsafe_timeline", {"height": found})
+        assert tl["height"] == found and tl["lifecycle_complete"]
+        assert tl["causal_ok"] and tl["spans"]
+        # unsafe_trace: state + aggregation, and live disable/enable
+        view = _rpc(base, "unsafe_trace", {})
+        assert view["enabled"] and view["spans"] > 0
+        assert "consensus.step" in view["summary"]
+        view = _rpc(base, "unsafe_trace", {"enable": False})
+        assert not view["enabled"]
+        view = _rpc(base, "unsafe_trace", {"enable": True})
+        assert view["enabled"]
+    finally:
+        cluster.stop()

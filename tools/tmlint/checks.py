@@ -1,4 +1,4 @@
-"""The tmlint rule set: 8 project invariants as AST checks.
+"""The tmlint rule set: 9 project invariants as AST checks.
 
 Each rule is a pure function Project -> [Finding], registered under the
 name used in output, pragmas, and --rule. The concurrency rules share one
@@ -15,6 +15,7 @@ Rules (docs/LINT.md has the full table with the motivating PR trail):
   metrics-discipline      labeled counters/gauges pre-seeded or removal-
                           disciplined (bounded exposition)
   fault-site-registry     faults.fire(...) literals canonical + documented
+  trace-span-discipline   trace span(...) names canonical + documented
   config-knob-parity      TM_TPU_*/TMTPU_* knobs <-> docs/CONFIG.md
 """
 
@@ -928,6 +929,107 @@ def check_fault_sites(project: Project) -> list[Finding]:
                     _FAULTS_DOC, i, "fault-site-registry",
                     f"docs/FAULTS.md names site '{tok}' which is not in "
                     f"CANONICAL_SITES (stale or undeclared)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rule: trace-span-discipline
+# ---------------------------------------------------------------------------
+
+_TRACE_FILE = "tendermint_tpu/utils/trace.py"
+_TRACE_DOC = "docs/OBSERVABILITY.md"
+# The flight-recorder recording surface (utils/trace.py): dotted-name
+# string literals passed to these terminals are span names. Non-dotted
+# first args (peerscore offences, dict keys) never match _SITE_RE, so the
+# family can stay broad without false positives.
+_SPAN_FAMILY = {"span", "mark"}
+_SPAN_RECORD = "record"
+
+
+def _canonical_spans(project: Project) -> dict[str, int]:
+    """span name -> declaration line, parsed from the CANONICAL_SPANS dict
+    literal (no project import: the linter stays jax-free) — the exact
+    pattern of fault-site-registry's CANONICAL_SITES."""
+    sf = project.file(_TRACE_FILE)
+    spans: dict[str, int] = {}
+    if sf is None or sf.tree is None:
+        text = project.read_side_file(_TRACE_FILE)
+        if text is None:
+            return spans
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            return spans
+    else:
+        tree = sf.tree
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        if (targets
+                and any(isinstance(t, ast.Name) and t.id == "CANONICAL_SPANS"
+                        for t in targets)
+                and isinstance(node.value, ast.Dict)):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    spans[k.value] = k.lineno
+    return spans
+
+
+@rule("trace-span-discipline",
+      "trace.span/mark/record name literals must be canonical + documented")
+def check_trace_spans(project: Project) -> list[Finding]:
+    spans = _canonical_spans(project)
+    out = []
+    if not spans:
+        return [Finding(_TRACE_FILE, 1, "trace-span-discipline",
+                        "CANONICAL_SPANS dict not found/parsable")]
+    namespaces = {s.split(".")[0] for s in spans}
+    for sf in project.prod_files():
+        if sf.path == _TRACE_FILE:
+            continue
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            t = terminal(node.func)
+            if t not in _SPAN_FAMILY and t != _SPAN_RECORD:
+                continue
+            lit = node.args[0].value
+            if not _SITE_RE.match(lit):
+                continue
+            if t == _SPAN_RECORD and not isinstance(node.func, ast.Attribute):
+                continue  # a bare record() is some other module's function
+            if lit not in spans:
+                out.append(Finding(
+                    sf.path, node.lineno, "trace-span-discipline",
+                    f"trace span '{lit}' is not declared in "
+                    f"utils/trace.py CANONICAL_SPANS — ad-hoc span names "
+                    f"drift from docs/OBSERVABILITY.md"))
+    doc = project.read_side_file(_TRACE_DOC)
+    if doc is None:
+        out.append(Finding(_TRACE_DOC, 1, "trace-span-discipline",
+                           "docs/OBSERVABILITY.md missing"))
+        return out
+    for span_name in sorted(spans):
+        if span_name not in doc:
+            out.append(Finding(
+                _TRACE_FILE, spans[span_name], "trace-span-discipline",
+                f"canonical span '{span_name}' is not documented in "
+                f"docs/OBSERVABILITY.md"))
+    for i, line in enumerate(doc.splitlines(), start=1):
+        for tok in re.findall(r"`([^`]+)`", line):
+            if (_SITE_RE.match(tok) and tok not in spans
+                    and tok.split(".")[0] in namespaces
+                    and "." in tok):
+                out.append(Finding(
+                    _TRACE_DOC, i, "trace-span-discipline",
+                    f"docs/OBSERVABILITY.md names span '{tok}' which is "
+                    f"not in CANONICAL_SPANS (stale or undeclared)"))
     return out
 
 
