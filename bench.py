@@ -649,6 +649,182 @@ def config_addvote(rr):
                 **detail)
 
 
+def config_concurrent_verify(rr):
+    """ISSUE 11 acceptance: M simultaneous verify paths — the consensus
+    vote drain, the fast-sync commit-verify primitive, and light range
+    verification — hammering the device CONCURRENTLY, with the
+    continuous-batching verify service on vs off (TMTPU_VERIFY_SERVICE=0).
+
+    The service's whole claim is that N concurrent callers share kernel
+    launches (one sync floor, not N), so the reported numbers are the
+    aggregate decisions/s of the storm, each path's per-decision p50, the
+    service's coalescing stats, and the flight-recorder phase attribution
+    per path for BOTH sides — the win must show up as the per-decision
+    readback/host_prep share shrinking, not just a better total."""
+    import threading
+
+    from tendermint_tpu.crypto import sigcache, verify_service
+    from tendermint_tpu.light.range_verify import verify_header_range
+    from tendermint_tpu.types.block import Header
+    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+    from tendermint_tpu.types.ttime import Time
+    from tendermint_tpu.types.vote import PREVOTE_TYPE, Vote
+    from tendermint_tpu.types.vote_set import VoteSet
+    from tendermint_tpu.utils import trace as tmtrace
+
+    iters_per_path = int(os.environ.get("BENCH_CONCURRENT_ITERS", 4))
+    t0 = time.monotonic()
+    # drain path: 512-validator prevote pile through VoteSet.add_votes
+    d_privs, d_vals = _mk_valset(512)
+    d_bid = BlockID(hash=b"\x31" * 32,
+                    part_set_header=PartSetHeader(total=1, hash=b"\x32" * 32))
+    d_votes = []
+    for i, p in enumerate(d_privs):
+        v = Vote(type=PREVOTE_TYPE, height=1, round=0, block_id=d_bid,
+                 timestamp=Time(1_700_002_000, 0),
+                 validator_address=d_vals.validators[i].address,
+                 validator_index=i)
+        v.signature = p.sign(v.sign_bytes(BENCH_CHAIN))
+        d_votes.append(v)
+    # fastsync path: 512-validator commit through verify_commit_light
+    f_privs, f_vals = _mk_valset(512, power=7)
+    f_header = Header(chain_id=BENCH_CHAIN, height=13,
+                      time=Time(1_700_002_100, 0), last_block_id=BlockID(),
+                      validators_hash=f_vals.hash(),
+                      next_validators_hash=f_vals.hash(),
+                      proposer_address=f_vals.validators[0].address)
+    f_commit = _sign_commit(f_header, f_vals, f_privs)
+    # range path: light header chain (BASELINE config 3 shape, small)
+    r_headers = int(os.environ.get("BENCH_CONCURRENT_RANGE_HEADERS", 192))
+    r_chain = _gen_light_chain(r_headers, 4)
+    r_trusted, r_rest = r_chain[0], r_chain[1:]
+    r_now = Time(1_700_000_000 + 10 * (r_headers + 2), 0)
+    gen_s = time.monotonic() - t0
+
+    def drain_decision():
+        vs = VoteSet(BENCH_CHAIN, 1, 0, PREVOTE_TYPE, d_vals)
+        results = vs.add_votes(d_votes)
+        assert all(a for a, _ in results)
+
+    def fastsync_decision():
+        f_vals.verify_commit_light(BENCH_CHAIN, f_commit.block_id, 13,
+                                   f_commit)
+
+    def range_decision():
+        verify_header_range(r_trusted, r_rest, 14 * 86400.0, r_now)
+
+    paths = (("drain", drain_decision), ("fastsync", fastsync_decision),
+             ("range", range_decision))
+
+    def storm(collect=None):
+        """One concurrent pass: every path runs iters_per_path decisions on
+        its own thread. collect[path] <- per-decision wall times."""
+        barrier = threading.Barrier(len(paths))
+        errors = []
+
+        def worker(name, fn, tracer):
+            try:
+                if tracer is not None:
+                    stack = tracer.activate()
+                    stack.__enter__()
+                barrier.wait()
+                for _ in range(iters_per_path):
+                    t = time.monotonic()
+                    fn()
+                    if collect is not None:
+                        collect[name].append(time.monotonic() - t)
+                if tracer is not None:
+                    stack.__exit__(None, None, None)
+            except Exception as e:  # noqa: BLE001 - surfaced after join
+                errors.append((name, e))
+
+        tracers = {name: (tmtrace.Tracer(name=f"bench-cv-{name}", cap=65536,
+                                         enabled=True)
+                          if collect is not None else None)
+                   for name, _ in paths}
+        threads = [threading.Thread(target=worker, args=(n, f, tracers[n]))
+                   for n, f in paths]
+        t = time.monotonic()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.monotonic() - t
+        for tr in tracers.values():
+            if tr is not None:
+                tr.disable()
+        if errors:
+            raise RuntimeError(f"concurrent_verify path failed: {errors}")
+        return wall, tracers
+
+    def measure(service_on):
+        prev = os.environ.get("TMTPU_VERIFY_SERVICE")
+        os.environ["TMTPU_VERIFY_SERVICE"] = "1" if service_on else "0"
+        verify_service.reset()
+        try:
+            storm()  # warm kernels/keysets for this routing
+            walls = []
+            collect = {n: [] for n, _ in paths}
+            tracers = None
+            for _ in range(2):
+                w, trs = storm(collect=collect)
+                walls.append(w)
+                tracers = trs
+            svc = verify_service.get()
+            phases = {n: _span_phases_us(tracers[n].summarize())
+                      for n, _ in paths}
+            return dict(
+                wall_s=min(walls),
+                agg_decisions_per_s=(len(paths) * iters_per_path * 2
+                                     / sum(walls)),
+                per_path_p50_ms={n: round(statistics.median(ts) * 1e3, 1)
+                                 for n, ts in collect.items()},
+                # per-decision phases: `tracers` holds the LAST storm's
+                # fresh Tracer objects, so totals cover iters_per_path
+                # decisions (NOT both storms)
+                phase_attribution={
+                    n: {k: round(v / iters_per_path, 1)
+                        for k, v in phases[n].items()}
+                    for n, _ in paths},
+                service=dict(launches=svc.launches, requests=svc.requests,
+                             max_coalesced=svc.max_coalesced,
+                             fallbacks=svc.fallbacks),
+            )
+        finally:
+            if prev is None:
+                os.environ.pop("TMTPU_VERIFY_SERVICE", None)
+            else:
+                os.environ["TMTPU_VERIFY_SERVICE"] = prev
+            verify_service.reset()
+
+    prev_sc = os.environ.get("TM_TPU_SIGCACHE")
+    os.environ["TM_TPU_SIGCACHE"] = "0"  # keep every decision VERIFYING
+    try:
+        on = measure(True)
+        off = measure(False)
+    finally:
+        if prev_sc is None:
+            os.environ.pop("TM_TPU_SIGCACHE", None)
+        else:
+            os.environ["TM_TPU_SIGCACHE"] = prev_sc
+        sigcache.reset()
+    speedup = on["agg_decisions_per_s"] / max(off["agg_decisions_per_s"],
+                                              1e-9)
+    return dict(metric="concurrent_verify_3path_agg_decisions_per_s",
+                value=round(on["agg_decisions_per_s"], 2),
+                unit="decisions/s",
+                vs_baseline=round(speedup, 2),
+                speedup_vs_service_off=round(speedup, 2),
+                service_off_decisions_per_s=round(
+                    off["agg_decisions_per_s"], 2),
+                per_path_p50_ms_on=on["per_path_p50_ms"],
+                per_path_p50_ms_off=off["per_path_p50_ms"],
+                phase_attribution_on=on["phase_attribution"],
+                phase_attribution_off=off["phase_attribution"],
+                service_stats=on["service"],
+                iters_per_path=iters_per_path, gen_s=round(gen_s, 1))
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -716,6 +892,7 @@ def main() -> None:
         ("fastsync", config_fastsync, (rr,)),
         ("sr25519", config_sr25519, (rr,)),
         ("addvote", config_addvote, (rr,)),
+        ("concurrent_verify", config_concurrent_verify, (rr,)),
         ("sharded", config_sharded, (rr, items)),
     ):
         try:
@@ -742,7 +919,14 @@ def main() -> None:
                                   "speedup_vs_depth1", "skipped", "devices",
                                   "single_device_marginal_us",
                                   "speedup_vs_single", "phase_attribution",
-                                  "trace_overhead_pct")}
+                                  "trace_overhead_pct",
+                                  "speedup_vs_service_off",
+                                  "service_off_decisions_per_s",
+                                  "per_path_p50_ms_on",
+                                  "per_path_p50_ms_off",
+                                  "phase_attribution_on",
+                                  "phase_attribution_off",
+                                  "service_stats")}
                     for k, v in configs.items()},
     }
     print(json.dumps(result))

@@ -39,6 +39,14 @@ decisions are unchanged.
 
 `TM_TPU_VERIFY_AHEAD` sets the depth (default 4; 1 = serial behavior,
 one decision dispatched and resolved at a time). See docs/PIPELINE.md.
+
+Device-bound speculative dispatches also ride the continuous-batching
+verify service (crypto/verify_service.py): the depth-K burst issued by `_fill`
+coalesces into shared kernel launches with whatever else is verifying
+concurrently (the consensus drain, light range chunks, other fabric
+nodes), and the service's executor owns the batched readback — `prefetch`
+below then simply waits on the already-coalesced results instead of
+issuing its own fetch.
 """
 
 from __future__ import annotations

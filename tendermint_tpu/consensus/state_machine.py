@@ -528,7 +528,17 @@ class ConsensusState:
         with consensus work). Verification inputs are state-independent --
         (pubkey, sign bytes, signature) fixed at dispatch -- and batch k is
         always applied before batch k+1, so observable ordering is exactly
-        the serial drain's."""
+        the serial drain's.
+
+        A DEVICE-BOUND dispatch lands on the continuous-batching verify
+        service (crypto/verify_service.py): this drain's flush coalesces
+        with any concurrent fast-sync / range / fabric-peer dispatches into
+        ONE shared kernel launch, so a drain racing other verify traffic
+        pays one sync floor, not one each (sub-crossover host flushes keep
+        verifying inline — they never pay a floor). has_device_output() on
+        the returned handle sees through to an in-flight service request,
+        so the stash-and-overlap path below engages exactly as with a raw
+        device handle."""
         from tendermint_tpu.crypto import batch as crypto_batch
         from tendermint_tpu.crypto import sigcache
 

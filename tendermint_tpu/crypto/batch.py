@@ -52,15 +52,19 @@ class PendingVerify:
     sub-batches that already resolved on host); ``resolve_fn(fetched)`` --
     with ``fetched`` parallel to ``devs`` -- replays the per-item bitmap.
     ``resolve()`` is idempotent: the first call fetches and caches, later
-    calls return the cached (all_ok, bitmap)."""
+    calls return the cached (all_ok, bitmap). ``children`` are sub-handles
+    (MixedBatchVerifier's per-key-type pendings, which may be
+    service-backed) whose in-flight state counts toward
+    has_device_output()."""
 
     __slots__ = ("_devs", "_resolve", "_result", "_tracer", "_t_disp",
-                 "_t_height")
+                 "_t_height", "_children")
 
-    def __init__(self, devs, resolve_fn):
+    def __init__(self, devs, resolve_fn, children=()):
         self._devs = list(devs)
         self._resolve = resolve_fn
         self._result: tuple[bool, list[bool]] | None = None
+        self._children = tuple(children)
         # flight-recorder context captured at dispatch (utils/trace.py):
         # the dispatching node's tracer, the dispatch timestamp (queue-wait
         # phase = resolve start - dispatch end), and the height context so
@@ -73,9 +77,18 @@ class PendingVerify:
     def resolved(self) -> bool:
         return self._result is not None
 
-    def has_device_output(self) -> bool:
-        """True when resolve() will block on a device fetch."""
+    def _devs_pending(self) -> bool:
+        """Unfetched device buffers of THIS handle (children excluded):
+        exactly the condition under which a _device_get is warranted."""
         return self._result is None and any(d is not None for d in self._devs)
+
+    def has_device_output(self) -> bool:
+        """True when resolve() will block — on a device fetch, or on a
+        service-backed child whose shared launch is still in flight."""
+        if self._result is not None:
+            return False
+        return (self._devs_pending()
+                or any(c.has_device_output() for c in self._children))
 
     def _finish(self, fetched) -> None:
         self._result = self._resolve(fetched)
@@ -96,7 +109,12 @@ class PendingVerify:
                 if self._t_disp:
                     tr.record("verify.queue",
                               _time.monotonic() - self._t_disp, **tags)
-                if self.has_device_output():
+                # _devs_pending, NOT has_device_output: a handle whose only
+                # in-flight work is service-backed children has nothing to
+                # fetch itself — a _device_get here would be a pointless
+                # trip through the audited choke (and a phantom count on
+                # the perf-gate fetch spy)
+                if self._devs_pending():
                     with tr.span("verify.readback", **tags):
                         fetched = _device_get(self._devs)
                 else:
@@ -104,9 +122,46 @@ class PendingVerify:
                 with tr.span("verify.replay", **tags):
                     self._finish(fetched)
             else:
-                fetched = (_device_get(self._devs) if self.has_device_output()
+                fetched = (_device_get(self._devs) if self._devs_pending()
                            else self._devs)
                 self._finish(fetched)
+        return self._result
+
+
+class ServicePending(PendingVerify):
+    """A dispatch routed through the continuous-batching verify service
+    (crypto/verify_service.py). The service executor owns host prep, the
+    shared (coalesced) kernel launch, and the single batched readback;
+    resolve() therefore waits on the request's completion event instead of
+    fetching device buffers itself. Exactly-once: the executor resolves
+    every request exactly once (result or error), and resolve() caches."""
+
+    __slots__ = ("_req",)
+
+    def __init__(self, req):
+        super().__init__([], None)
+        self._req = req
+
+    def has_device_output(self) -> bool:
+        """True while the shared launch is still in flight (resolve() would
+        block on the service), so async callers (the vote drain, the
+        verify-ahead pipeline) keep overlapping exactly as they do with a
+        raw device handle."""
+        return self._result is None and not self._req.done.is_set()
+
+    def _finish(self, _fetched) -> None:
+        req = self._req
+        req.done.wait()
+        if req.error is not None:
+            raise req.error
+        self._result = req.result
+        self._req = None
+        self._resolve = None
+        self._devs = []
+
+    def resolve(self) -> tuple[bool, list[bool]]:
+        if self._result is None:
+            self._finish(None)
         return self._result
 
 
@@ -116,8 +171,15 @@ def prefetch(pendings) -> None:
     The tunnel round trip is latency-bound: K sequential resolves cost K
     floors, one batched fetch costs one. Results are cached on each handle,
     so the later in-order resolve() calls return instantly. Host-resolved
-    pendings are untouched."""
+    pendings are untouched. Service-backed pendings (ServicePending) carry
+    no device outputs of their own — the verify service already coalesces
+    their readbacks into its single fetch point — so they are simply
+    waited on."""
     unres = [p for p in pendings if p.has_device_output()]
+    svc = [p for p in unres if not p._devs_pending()]
+    unres = [p for p in unres if p._devs_pending()]
+    for p in svc:
+        p.resolve()
     if not unres:
         return
     if _trace.ENABLED:
@@ -208,6 +270,7 @@ class _KernelBatchVerifier(BatchVerifier):
 
     _scalar_module: str
     _ops_module: str
+    _kind: str = ""
     _batch_min_default: int = 32
 
     def __init__(self) -> None:
@@ -251,6 +314,23 @@ class _KernelBatchVerifier(BatchVerifier):
             scalar = self._module("_scalar_module")
             out = [scalar.verify(p, m, s) for (p, m, s) in items]
             return PendingVerify([None], lambda _f, _r=(all(out), out): _r)
+        # DEVICE-BOUND batches route through the continuous-batching verify
+        # service (crypto/verify_service.py): ONE device-owning executor
+        # coalesces concurrent dispatches into shared kernel launches, so N
+        # simultaneous callers pay one sync floor, not N. Sub-crossover
+        # host batches (inline C verify, no floor) stay direct — a thread
+        # hop + coalescing window per tiny flush is pure loss there. The
+        # service calls the same ops dispatch_batch below (same routing,
+        # fault sites, breaker), so the bitmap is byte-identical;
+        # TMTPU_VERIFY_SERVICE=0 restores direct dispatch for everything,
+        # =1 forces everything onto the service (tests/bench).
+        from tendermint_tpu.crypto import verify_service
+
+        if verify_service.enabled() and (
+                verify_service.force_all()
+                or verify_service.device_bound(len(items), force_device)):
+            return verify_service.get().submit(self._kind, items,
+                                               force_device=force_device)
         import time as _t
 
         from tendermint_tpu.utils import metrics as tmmetrics
@@ -293,6 +373,7 @@ class Ed25519BatchVerifier(_KernelBatchVerifier):
 
     _scalar_module = "tendermint_tpu.crypto.ed25519"
     _ops_module = "tendermint_tpu.ops.ed25519_batch"
+    _kind = "ed25519"
 
 
 class Sr25519BatchVerifier(_KernelBatchVerifier):
@@ -302,6 +383,7 @@ class Sr25519BatchVerifier(_KernelBatchVerifier):
 
     _scalar_module = "tendermint_tpu.crypto.sr25519"
     _ops_module = "tendermint_tpu.ops.sr25519_batch"
+    _kind = "sr25519"
     # Pure-Python scalar fallback costs ~18 ms/sig; the kernel pays off
     # almost immediately.
     _batch_min_default = 8
@@ -351,10 +433,21 @@ class MixedBatchVerifier(BatchVerifier):
             out = [results[kt][i] for (kt, i) in order]
             return all(out), out
 
-        mixed = PendingVerify(devs, resolve)
+        # Children make has_device_output() see through to service-backed
+        # sub-handles (their shared launch is in flight but they carry no
+        # device outputs of their own), so async callers keep overlapping.
+        mixed = PendingVerify(devs, resolve,
+                              children=[p for (_, p, _, _) in spans])
         if _trace.ENABLED:
             tracer = _trace.current()
-            if tracer.enabled:
+            # Own the queue/readback attribution UNLESS a service-backed
+            # child is involved: the service executor already records those
+            # phases per request, and a second caller-side queue record
+            # would double-count the wait. Host-resolved and direct-device
+            # mixed batches keep their pre-service span coverage.
+            svc_children = any(isinstance(p, ServicePending)
+                               for (_, p, _, _) in spans)
+            if tracer.enabled and not svc_children:
                 mixed._tracer = tracer
                 mixed._t_disp = _time.monotonic()
                 mixed._t_height = tracer.current_height()

@@ -75,6 +75,13 @@ def verify_header_range(trusted: LightBlock, chain: list[LightBlock],
     # otherwise run on host CPU synchronously (15 us/sig of 1-core time
     # that overlaps nothing) while a device flight is free. Ranges whose
     # whole signature count sits below the crossover stay one host flush.
+    # Each chunk dispatch lands on the continuous-batching verify service
+    # (crypto/verify_service.py): chunks queued within its coalescing
+    # window share ONE kernel launch (and its sync floor) with each other
+    # and with any concurrent drain/fast-sync traffic, which also removes
+    # the per-chunk launch jitter behind the r05 spread (ISSUE 11
+    # satellite 1) — the executor, not this caller, owns launch cadence
+    # and the single batched readback.
     crossover = _edb.host_crossover()
     est_per = max(1, (2 * chain[0].validator_set.size()) // 3 + 1)
     est_total = est_per * len(chain)

@@ -226,13 +226,18 @@ def _decode_neg(pub: bytes) -> np.ndarray | None:
 
 
 _KS_LOCK = threading.Lock()
-_KS_CACHE: OrderedDict[bytes, edb.KeySet] = OrderedDict()
+_KS_CACHE: OrderedDict[bytes, tuple[edb.KeySet, np.ndarray]] = OrderedDict()
+# unique-key-SET level (see edb.build_keyset): coalesced verify-service
+# launches reuse device tables across novel interleavings
+_KS_UNIQ_CACHE: OrderedDict[bytes, edb.KeySet] = OrderedDict()
 
 
 def get_keyset(pubs: list[bytes]) -> tuple[edb.KeySet, np.ndarray, np.ndarray]:
     """-> (KeySet, key_idx (N,) int32, pub_ok (N,) bool); comb tables of the
-    ristretto-decoded -A, device-resident, cached by pubkey byte sequence."""
-    return edb.build_keyset(pubs, _KS_CACHE, _KS_LOCK, _decode_neg)
+    ristretto-decoded -A, device-resident, cached by pubkey byte sequence
+    (level 1) and by unique-key-set digest (level 2)."""
+    return edb.build_keyset(pubs, _KS_CACHE, _KS_LOCK, _decode_neg,
+                            uniq_cache=_KS_UNIQ_CACHE)
 
 
 # ---------------------------------------------------------------------------

@@ -13,7 +13,11 @@ Production routing (docs/PARALLEL.md): both kernel ops modules
 time, so every caller of the BatchVerifier registry -- verify_commit_async,
 the fast-sync verify-ahead pipeline, the consensus vote drain, light
 range_verify -- gets multi-device sharding transparently through the deferred
-dispatch()/PendingVerify contract. Knobs:
+dispatch()/PendingVerify contract. With the continuous-batching verify
+service on (crypto/verify_service.py, the default), the size
+:func:`should_shard` sees is the COALESCED generation -- several callers'
+concurrent dispatches merged into one launch -- so multi-caller traffic
+crosses the sharding threshold sooner than any single caller would. Knobs:
 
   TM_TPU_SHARD=0       opt out of sharding entirely (single-device paths)
   TM_TPU_SHARD_MIN=N   batch-size floor for the sharded route (default

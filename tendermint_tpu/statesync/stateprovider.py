@@ -4,6 +4,13 @@ statesync/stateprovider.go:48 NewLightClientStateProvider).
 The trust anchor for state sync: every app hash / commit / State handed to
 the syncer is backed by light-client-verified headers, so a lying snapshot
 peer can at worst waste bandwidth, never forge state.
+
+Verification cost: each verify_light_block_at_height runs the light
+client's verify_commit_light(_trusting) through the BatchVerifier registry,
+which routes kernel-worthy flushes onto the continuous-batching verify
+service (crypto/verify_service.py) — a statesync bootstrap racing the
+node's other verify traffic (consensus drain, fast-sync) shares kernel
+launches and sync floors with it instead of paying its own.
 """
 
 from __future__ import annotations

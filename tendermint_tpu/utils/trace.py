@@ -69,6 +69,8 @@ CANONICAL_SPANS = {
     # attribution ROADMAP item 1 needs)
     "verify.host_prep": "host prep + kernel dispatch (ops dispatch_batch)",
     "verify.queue": "dispatch()->resolve() queue wait of a PendingVerify",
+    "verify.coalesce": "verify-service shared launch marker (requests/sigs "
+                       "coalesced into one kernel launch)",
     "verify.device": "device compute (bench attribution pass only)",
     "verify.readback": "blocking D2H fetch (crypto/batch._device_get)",
     "verify.replay": "bitmap fetch -> serial accept/reject replay",

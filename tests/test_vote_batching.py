@@ -140,6 +140,12 @@ def test_consensus_drain_applies_batch(big_net):
 
     cs.rs.step = cstypes.STEP_PREVOTE
     cs._handle_vote_batch(msgs)
+    # With the continuous-batching verify service, the flush is genuinely
+    # in flight when _handle_vote_batch returns (has_device_output() sees
+    # the shared launch) and the drain stashes it; the production loop
+    # applies it before any later state transition via _flush_pending_votes
+    # — drive that exact step here.
+    cs._flush_pending_votes()
     prevotes = cs.rs.votes.prevotes(0)
     assert sum(prevotes.bit_array()) == 63  # all but the corrupted one
     maj, ok = prevotes.two_thirds_majority()

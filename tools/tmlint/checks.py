@@ -563,26 +563,35 @@ def _tarjan(graph: dict) -> list[set]:
 # ---------------------------------------------------------------------------
 
 # Where host<->device syncs are ALLOWED: the kernel modules (finishers,
-# probes, warmup), the shard driver, and crypto/batch.py's _device_get —
-# the one choke point the whole sync-floor campaign (ROADMAP item 1)
-# instruments. Everything else must go through PendingVerify/resolve_all.
+# probes, warmup), the shard driver, and the two audited choke FUNCTIONS —
+# crypto/batch._device_get (every PendingVerify/prefetch readback) and
+# crypto/verify_service._readback (the continuous-batching service's
+# single blocking fetch, itself routed through _device_get). Everything
+# else must go through PendingVerify/resolve_all or the service; a stray
+# device_get/block_until_ready anywhere else re-introduces an unshared
+# ~104 ms sync floor the ROADMAP-1 campaign just removed.
 _DEVICE_ALLOW_DIRS = ("tendermint_tpu/ops/", "tendermint_tpu/parallel/")
-_DEVICE_CHOKE_FILE = "tendermint_tpu/crypto/batch.py"
-_DEVICE_CHOKE_FUNC = "_device_get"
+_DEVICE_CHOKE_FUNCS = (
+    ("tendermint_tpu/crypto/batch.py", "_device_get"),
+    ("tendermint_tpu/crypto/verify_service.py", "_readback"),
+)
 
 
 @rule("device-sync-choke-point",
       "jax.device_get/block_until_ready/np.asarray only at audited sites")
 def check_device_sync(project: Project) -> list[Finding]:
     out = []
+    choke_by_file: dict = {}
+    for path, func in _DEVICE_CHOKE_FUNCS:
+        choke_by_file.setdefault(path, set()).add(func)
     for sf in project.prod_files():
         if sf.path.startswith(_DEVICE_ALLOW_DIRS):
             continue
         choke_ranges = []
-        if sf.path == _DEVICE_CHOKE_FILE:
+        for func in choke_by_file.get(sf.path, ()):
             for node in ast.walk(sf.tree):
                 if (isinstance(node, ast.FunctionDef)
-                        and node.name == _DEVICE_CHOKE_FUNC):
+                        and node.name == func):
                     choke_ranges.append(
                         (node.lineno, max(getattr(n, "end_lineno", node.lineno)
                                           for n in ast.walk(node))))
@@ -605,8 +614,9 @@ def check_device_sync(project: Project) -> list[Finding]:
             out.append(Finding(
                 sf.path, node.lineno, "device-sync-choke-point",
                 f"{hit} outside the audited sync sites — route through "
-                f"crypto/batch._device_get (PendingVerify/resolve_all) so "
-                f"the ~104 ms sync floor stays at one choke point"))
+                f"crypto/batch._device_get (PendingVerify/resolve_all) or "
+                f"the verify service's _readback so the ~104 ms sync floor "
+                f"stays at the audited choke points"))
     return out
 
 
