@@ -28,10 +28,17 @@ class ABCISocketClient:
                  connect_retries: int = 20, retry_interval_s: float = 0.25):
         self.addr = addr
         self.timeout_s = timeout_s
+        self._retries = connect_retries
+        self._retry_interval = retry_interval_s
         self._mtx = threading.Lock()
         self._sock: socket.socket | None = None
         self._rfile = None
         self._wfile = None
+        # None = unprobed: the first check_tx_batch sends an EMPTY batch
+        # probe (structural — no app code runs, so an error can only mean
+        # the server doesn't know the wire extension, whatever its error
+        # wording); True/False is the cached verdict (docs/INGEST.md)
+        self._batch_checktx: bool | None = None
         self._connect(connect_retries, retry_interval_s)
 
     def _connect(self, retries: int, interval: float) -> None:
@@ -105,6 +112,46 @@ class ABCISocketClient:
 
     def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
         return self._call("check_tx", req)
+
+    def check_tx_batch(self, req: abci.RequestCheckTxBatch) -> abci.ResponseCheckTxBatch:
+        """One round trip for a whole micro-batch (wire extension fields
+        19/20). Support is PROBED structurally on first use: an empty
+        batch never reaches app code, so any error response can only mean
+        the server doesn't decode the extension oneof (a reference v0.34
+        app) — that verdict is cached and this client degrades to the
+        serial per-tx loop for good. App exceptions and transport faults
+        on REAL batches propagate untouched: they say nothing about batch
+        support, and the mempool layer already degrades that one call to
+        its serial loop."""
+        if self._batch_checktx is None:
+            try:
+                self._call("check_tx_batch",
+                           abci.RequestCheckTxBatch(txs=[], type=req.type))
+                self._batch_checktx = True
+            except (wire.ABCIRemoteError, ABCIClientError):
+                # unknown-request answer (and, for servers that tear the
+                # connection down after it, a dead socket): no extension
+                self._batch_checktx = False
+                self._reconnect()
+        if self._batch_checktx:
+            return self._call("check_tx_batch", req)
+        return abci.ResponseCheckTxBatch(responses=[
+            self.check_tx(abci.RequestCheckTx(tx=tx, type=req.type))
+            for tx in req.txs
+        ])
+
+    def _reconnect(self) -> None:
+        """Atomic close+redial under the client mutex, so a concurrent
+        _call can never land in the socketless window (and two concurrent
+        reconnects can't leak an fd)."""
+        with self._mtx:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+            self._connect(self._retries, self._retry_interval)
 
     def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
         return self._call("init_chain", req)

@@ -30,6 +30,10 @@ _METHODS = {
     "Query": ("query", 6, 7),
     "BeginBlock": ("begin_block", 7, 8),
     "CheckTx": ("check_tx", 8, 9),
+    # extension method (docs/INGEST.md): not in the reference service; a
+    # reference server answers UNIMPLEMENTED and the client degrades to
+    # the serial loop
+    "CheckTxBatch": ("check_tx_batch", 19, 20),
     "DeliverTx": ("deliver_tx", 9, 10),
     "EndBlock": ("end_block", 10, 11),
     "Commit": (wire.COMMIT, 11, 12),
@@ -122,6 +126,7 @@ class ABCIGrpcClient:
 
     def __init__(self, addr: str, timeout_s: float = 10.0):
         self.timeout_s = timeout_s
+        self._batch_checktx = True  # until a server answers UNIMPLEMENTED
         self._channel = grpc.insecure_channel(addr.split("://", 1)[-1])
         self._calls = {
             name: self._channel.unary_unary(
@@ -173,6 +178,25 @@ class ABCIGrpcClient:
 
     def check_tx(self, req):
         return self._call("CheckTx", req)
+
+    def check_tx_batch(self, req):
+        """One RPC for a whole micro-batch. Only UNIMPLEMENTED — the
+        definitive pre-batch-server answer — disables the extension for
+        the client's lifetime; transient transport faults and app
+        exceptions propagate (the mempool layer degrades that one call to
+        its serial loop), so one blip can't silently cost the batching
+        win forever."""
+        if self._batch_checktx:
+            try:
+                return self._call("CheckTxBatch", req)
+            except grpc.RpcError as e:
+                if e.code() != grpc.StatusCode.UNIMPLEMENTED:
+                    raise
+                self._batch_checktx = False
+        return abci.ResponseCheckTxBatch(responses=[
+            self.check_tx(abci.RequestCheckTx(tx=tx, type=req.type))
+            for tx in req.txs
+        ])
 
     def init_chain(self, req):
         return self._call("InitChain", req)

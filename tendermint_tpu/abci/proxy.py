@@ -21,9 +21,10 @@ from dataclasses import dataclass
 from tendermint_tpu.abci import types as abci
 
 _APP_METHODS = (
-    "info", "set_option", "query", "check_tx", "init_chain", "begin_block",
-    "deliver_tx", "end_block", "commit", "list_snapshots", "offer_snapshot",
-    "load_snapshot_chunk", "apply_snapshot_chunk",
+    "info", "set_option", "query", "check_tx", "check_tx_batch",
+    "init_chain", "begin_block", "deliver_tx", "end_block", "commit",
+    "list_snapshots", "offer_snapshot", "load_snapshot_chunk",
+    "apply_snapshot_chunk",
 )
 
 

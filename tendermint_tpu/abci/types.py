@@ -163,6 +163,17 @@ class RequestCheckTx:
 
 
 @dataclass
+class RequestCheckTxBatch:
+    """Batched CheckTx: one ABCI round trip prices a whole micro-batch (no
+    reference analogue — the tx ingestion front door, docs/INGEST.md).
+    Carried on wire-extension oneof fields 19/20 (abci/wire.py); apps that
+    don't override the Application shim get exact per-tx loop semantics."""
+
+    txs: list[bytes] = field(default_factory=list)
+    type: int = CHECK_TX_TYPE_NEW
+
+
+@dataclass
 class RequestDeliverTx:
     tx: bytes = b""
 
@@ -269,6 +280,13 @@ class ResponseCheckTx:
 
     def is_ok(self) -> bool:
         return self.code == CODE_TYPE_OK
+
+
+@dataclass
+class ResponseCheckTxBatch:
+    """Per-tx responses, order-aligned with RequestCheckTxBatch.txs."""
+
+    responses: list[ResponseCheckTx] = field(default_factory=list)
 
 
 @dataclass
@@ -392,6 +410,15 @@ class Application:
 
     def check_tx(self, req: RequestCheckTx) -> ResponseCheckTx:
         return ResponseCheckTx()
+
+    def check_tx_batch(self, req: RequestCheckTxBatch) -> ResponseCheckTxBatch:
+        """Loop-fallback shim: apps that don't implement batched CheckTx
+        get the serial loop's exact per-tx semantics — batching is an
+        optimization seam (docs/INGEST.md), never a semantic change."""
+        return ResponseCheckTxBatch(responses=[
+            self.check_tx(RequestCheckTx(tx=tx, type=req.type))
+            for tx in req.txs
+        ])
 
     def init_chain(self, req: RequestInitChain) -> ResponseInitChain:
         return ResponseInitChain()

@@ -12,6 +12,10 @@ wire-compatible with a Go tendermint v0.34 socket app:
             query=7 begin_block=8 check_tx=9 deliver_tx=10 end_block=11
             commit=12 list_snapshots=13 offer_snapshot=14
             load_snapshot_chunk=15 apply_snapshot_chunk=16
+
+Extension (NOT in the reference proto — this tree's ingestion front door,
+docs/INGEST.md): check_tx_batch rides Request field 19 / Response field 20;
+clients fall back to a serial CheckTx loop against pre-batch servers.
 """
 
 from __future__ import annotations
@@ -135,6 +139,33 @@ def _events_marshal(w: proto.Writer, fieldnum: int, events) -> None:
         w.message(fieldnum, e.marshal(), always=True)
 
 
+def _check_tx_resp_marshal(resp: abci.ResponseCheckTx) -> bytes:
+    cw = (proto.Writer().uvarint(1, resp.code).bytes(2, resp.data)
+          .string(3, resp.log).string(4, resp.info)
+          .varint(5, resp.gas_wanted).varint(6, resp.gas_used))
+    _events_marshal(cw, 7, resp.events)
+    cw.string(8, resp.codespace).string(9, resp.sender)
+    cw.varint(10, resp.priority).string(11, resp.mempool_error)
+    return cw.out()
+
+
+def _check_tx_resp_unmarshal(buf: bytes) -> abci.ResponseCheckTx:
+    from tendermint_tpu.abci.types import Event
+
+    m = proto.fields(buf)
+    return abci.ResponseCheckTx(
+        code=m.get(1, [0])[-1], data=m.get(2, [b""])[-1],
+        log=m.get(3, [b""])[-1].decode() if 3 in m else "",
+        info=m.get(4, [b""])[-1].decode() if 4 in m else "",
+        gas_wanted=proto.as_sint64(m.get(5, [0])[-1]),
+        gas_used=proto.as_sint64(m.get(6, [0])[-1]),
+        events=[Event.unmarshal(b) for b in m.get(7, [])],
+        codespace=m.get(8, [b""])[-1].decode() if 8 in m else "",
+        sender=m.get(9, [b""])[-1].decode() if 9 in m else "",
+        priority=proto.as_sint64(m.get(10, [0])[-1]),
+        mempool_error=m.get(11, [b""])[-1].decode() if 11 in m else "")
+
+
 # --- request encode/decode --------------------------------------------------
 
 ECHO, FLUSH, COMMIT = "echo", "flush", "commit"
@@ -174,6 +205,16 @@ def encode_request(kind: str, req=None) -> bytes:
     elif kind == "check_tx":
         inner = proto.Writer().bytes(1, req.tx).varint(2, req.type).out()
         w.message(8, inner, always=True)
+    elif kind == "check_tx_batch":
+        # extension field (not in the reference proto): the ingestion
+        # front door's one-round-trip micro-batch (docs/INGEST.md)
+        bw = proto.Writer()
+        for t in req.txs:
+            # message(always=True), not bytes(): a repeated element must
+            # be emitted even when empty, or the batch shape collapses
+            bw.message(1, t, always=True)
+        bw.varint(2, req.type)
+        w.message(19, bw.out(), always=True)
     elif kind == "deliver_tx":
         w.message(9, proto.Writer().bytes(1, req.tx).out(), always=True)
     elif kind == "end_block":
@@ -273,6 +314,11 @@ def decode_request(buf: bytes) -> tuple[str, object]:
         return "apply_snapshot_chunk", abci.RequestApplySnapshotChunk(
             index=m.get(1, [0])[-1], chunk=m.get(2, [b""])[-1],
             sender=m.get(3, [b""])[-1].decode() if 3 in m else "")
+    if 19 in f:  # extension: batched CheckTx (docs/INGEST.md)
+        m = proto.fields(f[19][-1])
+        return "check_tx_batch", abci.RequestCheckTxBatch(
+            txs=list(m.get(1, [])),
+            type=proto.as_sint64(m.get(2, [0])[-1]))
     if 4 in f:  # set_option (deprecated in the reference, kept for parity)
         m = proto.fields(f[4][-1])
         return "set_option", (
@@ -321,13 +367,12 @@ def encode_response(kind: str, resp=None, error: str | None = None) -> bytes:
         _events_marshal(bw, 1, resp.events)
         w.message(8, bw.out(), always=True)
     elif kind == "check_tx":
-        cw = (proto.Writer().uvarint(1, resp.code).bytes(2, resp.data)
-              .string(3, resp.log).string(4, resp.info)
-              .varint(5, resp.gas_wanted).varint(6, resp.gas_used))
-        _events_marshal(cw, 7, resp.events)
-        cw.string(8, resp.codespace).string(9, resp.sender).varint(10, resp.priority)
-        cw.string(11, resp.mempool_error)
-        w.message(9, cw.out(), always=True)
+        w.message(9, _check_tx_resp_marshal(resp), always=True)
+    elif kind == "check_tx_batch":
+        bw = proto.Writer()
+        for rtx in resp.responses:
+            bw.message(1, _check_tx_resp_marshal(rtx), always=True)
+        w.message(20, bw.out(), always=True)
     elif kind == "deliver_tx":
         w.message(10, resp.marshal(), always=True)
     elif kind == "end_block":
@@ -416,20 +461,11 @@ def decode_response(buf: bytes) -> tuple[str, object]:
         return "begin_block", abci.ResponseBeginBlock(
             events=[Event.unmarshal(b) for b in m.get(1, [])])
     if 9 in f:
-        from tendermint_tpu.abci.types import Event
-
-        m = proto.fields(f[9][-1])
-        return "check_tx", abci.ResponseCheckTx(
-            code=m.get(1, [0])[-1], data=m.get(2, [b""])[-1],
-            log=m.get(3, [b""])[-1].decode() if 3 in m else "",
-            info=m.get(4, [b""])[-1].decode() if 4 in m else "",
-            gas_wanted=proto.as_sint64(m.get(5, [0])[-1]),
-            gas_used=proto.as_sint64(m.get(6, [0])[-1]),
-            events=[Event.unmarshal(b) for b in m.get(7, [])],
-            codespace=m.get(8, [b""])[-1].decode() if 8 in m else "",
-            sender=m.get(9, [b""])[-1].decode() if 9 in m else "",
-            priority=proto.as_sint64(m.get(10, [0])[-1]),
-            mempool_error=m.get(11, [b""])[-1].decode() if 11 in m else "")
+        return "check_tx", _check_tx_resp_unmarshal(f[9][-1])
+    if 20 in f:  # extension: batched CheckTx (docs/INGEST.md)
+        m = proto.fields(f[20][-1])
+        return "check_tx_batch", abci.ResponseCheckTxBatch(
+            responses=[_check_tx_resp_unmarshal(b) for b in m.get(1, [])])
     if 10 in f:
         return "deliver_tx", abci.ResponseDeliverTx.unmarshal(f[10][-1])
     if 11 in f:

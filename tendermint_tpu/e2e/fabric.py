@@ -81,6 +81,12 @@ from tendermint_tpu.utils import nemesis
 PER_PEER_THREADS = 4
 PER_PEER_THREADS_MEMPOOL = 1
 NODE_BASE_THREADS = 5
+# Lazy ingest-coalescer executor (mempool/ingest.py, docs/INGEST.md): one
+# per node, spawned on the node's first front-door tx — submit_tx and every
+# gossip delivery route through it, so a loaded cluster holds one each.
+# Spending the scale budget deliberately: it buys one batched CheckTx
+# dispatch per micro-batch instead of one app round trip per tx.
+NODE_THREADS_INGEST = 1
 FDS_PER_LINK = 2       # one socketpair end per side
 FDS_PER_NODE = 6       # WAL + sqlite handles (durable) + metrics/rpc slack
 
@@ -604,7 +610,9 @@ class Cluster:
             if fn is None:
                 continue
             try:
-                res = fn.node.mempool.check_tx(tx)
+                # the batched client path (docs/INGEST.md): every seeded
+                # scenario's tx load exercises the coalesced front door
+                res = fn.node.mempool.ingest_tx(tx)
                 return bool(res is None or res.is_ok())
             except Exception:  # noqa: BLE001 - full/duplicate: try the next
                 continue
@@ -613,10 +621,13 @@ class Cluster:
     # --- resource budget ----------------------------------------------------
 
     def expected_thread_budget(self) -> int:
+        from tendermint_tpu.mempool import ingest as _ingest
+
         per_peer = PER_PEER_THREADS + (
             PER_PEER_THREADS_MEMPOOL if self.mempool_broadcast else 0)
         peer_sides = sum(len(fn.links) for fn in self.nodes.values())
-        per_node = NODE_BASE_THREADS + (1 if self.mempool_broadcast else 0)
+        per_node = NODE_BASE_THREADS + (1 if self.mempool_broadcast else 0) + (
+            NODE_THREADS_INGEST if _ingest.enabled() else 0)
         extra = (1 if self.metrics_node >= 0 else 0) + (
             2 if self.rpc_node >= 0 else 0)
         return len(self.nodes) * per_node + peer_sides * per_peer + extra

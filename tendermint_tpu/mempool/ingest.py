@@ -1,0 +1,217 @@
+"""Micro-batched tx ingestion front door (ROADMAP item 2, docs/INGEST.md).
+
+After PRs 2/4/11 coalesced all signature verification into shared kernel
+launches, tx admission was the last decision-at-a-time path: every
+``broadcast_tx_*`` and every gossiped tx paid its own ABCI CheckTx round
+trip and its own mempool lock acquisition. This module applies the same
+continuous-batching shape (crypto/verify_service.py) to ingestion:
+
+ * concurrent front-door submissions — RPC ``broadcast_tx_*`` handler
+   threads AND gossip ``MempoolReactor.receive`` deliveries — are queued
+   to one per-mempool :class:`IngestCoalescer`;
+ * a dedicated executor thread drains submissions arriving within a short
+   window (``TMTPU_INGEST_WINDOW_US``) into one
+   ``Mempool.check_tx_batch`` call: ONE mempool lock acquisition and ONE
+   batched ABCI ``RequestCheckTxBatch`` dispatch per micro-batch, with
+   per-tx outcomes scattered back to each waiter (the dispatch/resolve
+   seam shape of crypto/batch.PendingVerify);
+ * admission semantics are the SERIAL loop's, replayed in order inside
+   ``check_tx_batch`` — identical verdicts, priority order, cache effects,
+   and per-sender scoring attribution; only the app round trip amortizes;
+ * the RPC admission gate (rpc/core._TxAdmissionGate, docs/OVERLOAD.md)
+   composes unchanged: each batch-member's handler thread holds its own
+   slot for the life of its CheckTx, so shed behavior is identical while
+   the CheckTx cost under the slots amortizes.
+
+Knobs (docs/CONFIG.md): ``TMTPU_INGEST=0`` restores the serial per-tx
+path; ``TMTPU_INGEST_WINDOW_US`` sets the coalescing window (default
+200); ``TMTPU_INGEST_MAX_BATCH`` caps txs per shared batch (default 256).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time as _time
+
+
+def enabled() -> bool:
+    """False only when the operator opted out (TMTPU_INGEST=0; read per
+    submission so tests and the mempool_ingest bench can flip it without
+    rebuilding mempools)."""
+    return os.environ.get("TMTPU_INGEST") != "0"
+
+
+def window_us(default: int = 200) -> int:
+    """Coalescing window: how long the executor waits for more submissions
+    after the first before dispatching the shared batch. Latency cost for
+    a lone tx; the price of sharing the round trip for concurrent ones.
+    TMTPU_INGEST_WINDOW_US overrides."""
+    v = os.environ.get("TMTPU_INGEST_WINDOW_US")
+    try:
+        return max(0, int(v)) if v else default
+    except ValueError:
+        return default
+
+
+def max_batch(default: int = 256) -> int:
+    """Tx cap per shared batch (bounds one batch's lock-hold time and the
+    app's worst-case batched CheckTx). TMTPU_INGEST_MAX_BATCH overrides."""
+    v = os.environ.get("TMTPU_INGEST_MAX_BATCH")
+    try:
+        return max(1, int(v)) if v else default
+    except ValueError:
+        return default
+
+
+class PendingCheckTx:
+    """One caller's submitted tx: a completion event plus the outcome the
+    serial path would have produced — a ResponseCheckTx where check_tx
+    would return one, the exact exception instance where it would raise."""
+
+    __slots__ = ("done", "outcome")
+
+    def __init__(self) -> None:
+        self.done = threading.Event()
+        self.outcome: object = None
+
+    def wait(self):
+        """Block until the shared batch resolves; re-raise or return
+        exactly as the serial check_tx would."""
+        self.done.wait()
+        if isinstance(self.outcome, BaseException):
+            raise self.outcome
+        return self.outcome
+
+
+# Shutdown sentinel: stop() enqueues it; the executor drains up to it,
+# resolves everything in flight, and exits (a later submit restarts).
+_STOP = object()
+
+
+class IngestCoalescer:
+    """The mempool's batching executor. Lazy: the thread spawns on the
+    first submission (a mempool that never sees front-door traffic costs
+    nothing); daemonized, so it never blocks teardown — and stop() lets a
+    torn-down node release the thread (and its strong mempool/app refs)
+    instead of parking it forever."""
+
+    def __init__(self, mempool) -> None:
+        self.mempool = mempool
+        self._q: "queue.Queue[tuple[bytes, str, PendingCheckTx]]" = queue.Queue()
+        self._thread: threading.Thread | None = None
+        self._thread_mtx = threading.Lock()
+        self._stopping = False
+        # observability counters (read by the mempool_ingest bench and the
+        # ingest tests; plain ints — the GIL makes += atomic enough)
+        self.batches = 0          # shared check_tx_batch dispatches issued
+        self.requests = 0         # txs submitted
+        self.coalesced_txs = 0    # txs that shared a batch with >=1 other
+        self.max_coalesced = 0    # most txs sharing one batch
+
+    def submit(self, tx: bytes, sender: str = "") -> PendingCheckTx:
+        """Queue one tx; returns the caller's pending. Never blocks beyond
+        the queue put. Put and executor lifecycle share one mutex with
+        stop(), so a submission can never land BEHIND the shutdown
+        sentinel of a queue whose executor is exiting — after a stop(),
+        the next submit starts a fresh queue + executor."""
+        p = PendingCheckTx()
+        self.requests += 1
+        with self._thread_mtx:
+            if self._stopping:
+                # the old executor drains its queue up to the sentinel and
+                # dies; this submission belongs to a fresh generation
+                self._stopping = False
+                self._q = queue.Queue()
+                self._thread = None
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, args=(self._q,),
+                    name="mempool-ingest", daemon=True)
+                self._thread.start()
+            self._q.put((tx, sender, p))
+        return p
+
+    def stop(self) -> None:
+        """Release the executor: everything already queued still resolves
+        (all puts are ordered before the sentinel by the shared mutex),
+        then the thread exits and drops its mempool/app references. Node
+        teardown calls this so a churned-out node can't leak a parked
+        thread per restart; a later submit simply restarts the executor."""
+        with self._thread_mtx:
+            if self._thread is not None and self._thread.is_alive():
+                self._stopping = True
+                self._q.put(_STOP)
+
+    def _collect(self, q, first) -> tuple[list, bool]:
+        """The continuous-batching step: drain submissions arriving within
+        the coalescing window (or already queued), bounded by max_batch.
+        Returns (batch, stop) — stop when the shutdown sentinel was
+        drained mid-window (the batch still processes first; nothing can
+        follow the sentinel on this queue)."""
+        batch = [first]
+        cap = max_batch()
+        deadline = _time.monotonic() + window_us() / 1e6
+        while len(batch) < cap:
+            remaining = deadline - _time.monotonic()
+            try:
+                item = (q.get(timeout=remaining) if remaining > 0
+                        else q.get_nowait())
+            except queue.Empty:
+                break
+            if item is _STOP:
+                return batch, True
+            batch.append(item)
+        return batch, False
+
+    def _run(self, q) -> None:
+        while True:
+            batch = []
+            stopping = False
+            try:
+                first = q.get()
+                if first is _STOP:
+                    return
+                batch, stopping = self._collect(q, first)
+                self.batches += 1
+                self.max_coalesced = max(self.max_coalesced, len(batch))
+                if len(batch) > 1:
+                    self.coalesced_txs += len(batch)
+                self._observe(batch)
+                outcomes = self.mempool.check_tx_batch(
+                    [tx for (tx, _, _) in batch],
+                    [sender for (_, sender, _) in batch])
+                for (_, _, p), o in zip(batch, outcomes):
+                    p.outcome = o
+                    p.done.set()
+                if stopping:
+                    return
+            except Exception as e:  # noqa: BLE001 - the executor must never
+                # die: a stranded done-event would hang an RPC handler or a
+                # gossip recv thread forever. Waiters get the error (their
+                # wait() re-raises it, exactly where the serial path would
+                # have surfaced it).
+                for (_, _, p) in batch:
+                    if not p.done.is_set():
+                        p.outcome = e
+                        p.done.set()
+                if stopping:
+                    return
+
+    def _observe(self, batch) -> None:
+        """Coalescing marker on the owning node's flight recorder + the
+        pre-seeded ingest counters; observability must never be able to
+        strand a batch, so failures are swallowed."""
+        try:
+            tr = self.mempool.tracer
+            if tr is not None and tr.enabled:
+                tr.record("mempool.ingest_coalesce", 0.0,
+                          requests=len(batch))
+            from tendermint_tpu.utils import metrics as tmmetrics
+
+            m = tmmetrics.GLOBAL_NODE_METRICS
+            if m is not None and len(batch) > 1:
+                m.ingest_coalesced.add(len(batch))
+        except Exception:  # noqa: BLE001 - observability never blocks txs
+            pass

@@ -16,7 +16,10 @@ from collections import OrderedDict
 from dataclasses import dataclass, field as dc_field
 
 from tendermint_tpu.abci import types as abci
+from tendermint_tpu.mempool.ingest import IngestCoalescer
+from tendermint_tpu.mempool import ingest as _ingest
 from tendermint_tpu.types.tx import tx_key
+from tendermint_tpu.utils import faults
 
 
 class MempoolError(Exception):
@@ -76,6 +79,12 @@ class TxCache:
                 self._map.popitem(last=False)
             return True
 
+    def contains(self, tx: bytes) -> bool:
+        """Peek without the LRU bump (the batch pre-filter's dedup probe;
+        the authoritative push happens at the replay's serial position)."""
+        with self._mtx:
+            return tx_key(tx) in self._map
+
     def remove(self, tx: bytes) -> None:
         with self._mtx:
             self._map.pop(tx_key(tx), None)
@@ -117,6 +126,9 @@ class Mempool:
         # flight recorder (utils/trace.py): node wiring installs the node's
         # tracer; None = untraced (standalone mempools, tests)
         self.tracer = None
+        # the micro-batching front door (mempool/ingest.py): lazy executor,
+        # costs nothing until the first ingest_tx/ingest_txs submission
+        self._ingest = IngestCoalescer(self)
 
     # --- Mempool interface (reference: mempool/mempool.go:14-90) -----------
 
@@ -141,7 +153,13 @@ class Mempool:
         return self._txs_available
 
     def check_tx(self, tx: bytes, sender_peer: str = "") -> abci.ResponseCheckTx:
-        """Synchronous CheckTx (reference: mempool/v0/clist_mempool.go:203)."""
+        """Synchronous CheckTx (reference: mempool/v0/clist_mempool.go:203).
+
+        INVARIANT: check_tx_batch's phase-2 replay below mirrors this
+        decision procedure step for step; any semantic change here MUST be
+        mirrored there (the batched path's bit-identical guarantee is
+        differentially gated by tests/test_ingest.py and
+        __graft_entry__.ingest_stage, which will fail loudly on drift)."""
         if len(tx) > self.max_tx_bytes:
             raise ErrTxTooLarge(self.max_tx_bytes, len(tx))
         if self.pre_check is not None:
@@ -196,6 +214,245 @@ class Mempool:
             if not self.keep_invalid:
                 self.cache.remove(tx)
         return res
+
+    # --- the micro-batched front door (mempool/ingest.py, docs/INGEST.md) --
+
+    def ingest_tx(self, tx: bytes, sender_peer: str = "") -> abci.ResponseCheckTx:
+        """The coalesced front door: same returns and same raises as
+        check_tx, but concurrent callers (RPC handler threads, gossip recv
+        threads) share batched CheckTx dispatches through the ingest
+        coalescer. TMTPU_INGEST=0 restores the serial path verbatim."""
+        if not _ingest.enabled():
+            return self.check_tx(tx, sender_peer)
+        p = self._ingest.submit(tx, sender_peer)
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            t0 = time.monotonic()
+            try:
+                return p.wait()
+            finally:
+                tr.record("mempool.ingest_wait", time.monotonic() - t0)
+        return p.wait()
+
+    def ingest_txs(self, txs: list[bytes], sender_peer: str = "") -> list:
+        """Multi-tx front door (gossip deliveries): per-tx outcomes —
+        a ResponseCheckTx where the serial loop would return one, the
+        exception instance where it would raise. Never raises itself."""
+        if not _ingest.enabled():
+            out = []
+            for tx in txs:
+                try:
+                    out.append(self.check_tx(tx, sender_peer))
+                except Exception as e:  # noqa: BLE001 - outcome, not error
+                    out.append(e)
+            return out
+        pendings = [self._ingest.submit(tx, sender_peer) for tx in txs]
+        for p in pendings:
+            p.done.wait()
+        return [p.outcome for p in pendings]
+
+    def check_tx_batch(self, txs: list[bytes], senders: list[str] | None = None,
+                       tx_type: int = abci.CHECK_TX_TYPE_NEW) -> list:
+        """Admit a micro-batch through ONE batched ABCI CheckTx and ONE
+        mempool lock acquisition (docs/INGEST.md).
+
+        Returns a per-tx outcome list, order-aligned with ``txs``: a
+        ResponseCheckTx where the serial check_tx would return one, the
+        exact exception INSTANCE where it would raise. The decision
+        procedure IS the serial loop's, replayed in original order under
+        the lock — admission verdicts, v1 eviction, priority order, cache
+        effects, and per-sender attribution are bit-identical to N serial
+        calls; only the app round trip is batched. (A tx the replay later
+        rejects as full may have been priced by the app anyway — CheckTx
+        is stateless by ABCI contract, as in the reference's async
+        mempool.) A failure of the batched dispatch itself — injected
+        fault, transport error, a pre-batch remote app — degrades to the
+        serial per-tx loop, so every caller still gets the serial path's
+        exact outcome."""
+        n = len(txs)
+        if senders is None:
+            senders = [""] * n
+        out: list = [None] * n
+        # --- phase 1: per-tx pre-verdicts + the app-batch candidate set ----
+        # (size/pre_check verdicts are final; the cache probe only decides
+        # who rides the batched dispatch — the authoritative push happens
+        # at each tx's serial position in the replay below)
+        need: list[int] = []
+        seen: set[bytes] = set()
+        for i, tx in enumerate(txs):
+            if len(tx) > self.max_tx_bytes:
+                out[i] = ErrTxTooLarge(self.max_tx_bytes, len(tx))
+                continue
+            if self.pre_check is not None:
+                try:
+                    self.pre_check(tx)
+                except Exception as e:  # noqa: BLE001 - serial raises it
+                    out[i] = e
+                    continue
+            k = tx_key(tx)
+            if k in seen or self.cache.contains(tx):
+                # expected duplicate: no app call; the replay confirms via
+                # the real cache.push (and falls back to a serial app call
+                # when the earlier copy was un-cached in the meantime)
+                continue
+            seen.add(k)
+            need.append(i)
+        # --- the batched app round trips (outside the mempool lock) --------
+        responses: dict[int, object] = {}
+        if need:
+            batch = [txs[i] for i in need]
+            try:
+                faults.fire("mempool.ingest")
+                tr = self.tracer
+                if tr is not None and tr.enabled:
+                    with tr.span("mempool.ingest_batch", n=len(batch)):
+                        rs = self._batched_app_check(batch, tx_type)
+                else:
+                    rs = self._batched_app_check(batch, tx_type)
+                for i, r in zip(need, rs):
+                    responses[i] = r
+            except Exception:  # noqa: BLE001 - degrade to the serial loop
+                for i in need:
+                    try:
+                        responses[i] = self.app.check_tx(
+                            abci.RequestCheckTx(tx=txs[i], type=tx_type))
+                    except Exception as e:  # noqa: BLE001 - per-tx outcome
+                        responses[i] = e
+        # --- phase 2: serial-order replay under ONE lock acquisition -------
+        # INVARIANT: this loop IS check_tx's decision procedure (see its
+        # docstring) — keep the two in lockstep; the differential gates
+        # (tests/test_ingest.py, __graft_entry__.ingest_stage) fail on drift.
+        pushed: set[int] = set()
+        i = 0
+        while i < n:
+            deferred = -1
+            with self._mtx:
+                while i < n:
+                    if out[i] is not None:
+                        i += 1
+                        continue
+                    tx = txs[i]
+                    full = (len(self._txs) >= self.max_txs
+                            or self._txs_bytes + len(tx) > self.max_txs_bytes)
+                    if full and self.version != "v1":
+                        # v0 rejects-when-full BEFORE the cache push, so a
+                        # retry after commit is not refused as a duplicate
+                        out[i] = ErrMempoolIsFull(
+                            len(self._txs), self.max_txs,
+                            self._txs_bytes, self.max_txs_bytes)
+                        i += 1
+                        continue
+                    if i not in pushed:
+                        if not self.cache.push(tx):
+                            existing = self._txs.get(tx_key(tx))
+                            if existing is not None and senders[i]:
+                                existing.senders.add(senders[i])
+                            out[i] = ErrTxInCache()
+                            i += 1
+                            continue
+                        pushed.add(i)
+                    res = responses.get(i)
+                    if res is None:
+                        # a duplicate whose earlier copy was un-cached
+                        # before the replay reached it: the serial path
+                        # would call the app HERE — do so outside the lock
+                        deferred = i
+                        break
+                    if isinstance(res, Exception):
+                        # serial semantics: an app blow-up propagates
+                        # AFTER the cache push, with the tx left cached
+                        out[i] = res
+                        i += 1
+                        continue
+                    if self.post_check is not None:
+                        try:
+                            self.post_check(tx, res)
+                        except Exception as e:  # noqa: BLE001 - verdict
+                            if not self.keep_invalid:
+                                self.cache.remove(tx)
+                            out[i] = e
+                            i += 1
+                            continue
+                    if res.is_ok():
+                        try:
+                            self._make_room_locked(tx, res.priority)
+                        except MempoolError as e:
+                            out[i] = e
+                            i += 1
+                            continue
+                        self._seq += 1
+                        mtx = MempoolTx(
+                            tx=tx, height=self._height,
+                            gas_wanted=res.gas_wanted, priority=res.priority,
+                            sender=res.sender, seq=self._seq,
+                            time=time.monotonic())
+                        if senders[i]:
+                            mtx.senders.add(senders[i])
+                        self._txs[tx_key(tx)] = mtx
+                        self._txs_bytes += len(tx)
+                        self._notify_txs_available()
+                    else:
+                        if not self.keep_invalid:
+                            self.cache.remove(tx)
+                    out[i] = res
+                    i += 1
+            if deferred >= 0:
+                try:
+                    responses[deferred] = self.app.check_tx(
+                        abci.RequestCheckTx(tx=txs[deferred], type=tx_type))
+                except Exception as e:  # noqa: BLE001 - per-tx outcome
+                    responses[deferred] = e
+        self._observe_batch(n, out)
+        return out
+
+    # The ABCI wire caps one message at 100 MiB (abci/wire.py
+    # MAX_MSG_SIZE); a front-door batch of max_tx_bytes-sized txs (or a
+    # whole-pool recheck) must never be able to exceed it and kill the
+    # mempool connection. Chunked well under the cap.
+    BATCH_MAX_BYTES = 8 * 1024 * 1024
+
+    def _batched_app_check(self, txs: list[bytes], tx_type: int) -> list:
+        """One or more RequestCheckTxBatch round trips, chunked under
+        BATCH_MAX_BYTES. Returns responses order-aligned with ``txs``;
+        raises (to the caller's serial fallback) on a response-shape
+        mismatch or transport failure."""
+        out: list = []
+        start = 0
+        n = len(txs)
+        while start < n:
+            nbytes = 0
+            end = start
+            while end < n and (end == start
+                               or nbytes + len(txs[end]) <= self.BATCH_MAX_BYTES):
+                nbytes += len(txs[end])
+                end += 1
+            chunk = txs[start:end]
+            resp = self.app.check_tx_batch(
+                abci.RequestCheckTxBatch(txs=chunk, type=tx_type))
+            if len(resp.responses) != len(chunk):
+                raise MempoolError(
+                    f"CheckTxBatch returned {len(resp.responses)} responses "
+                    f"for {len(chunk)} txs")
+            out.extend(resp.responses)
+            start = end
+        return out
+
+    def _observe_batch(self, n: int, out: list) -> None:
+        """Pre-seeded ingest metrics (utils/metrics.py, tmlint
+        metrics-discipline); counters must never be able to fail a batch."""
+        try:
+            from tendermint_tpu.utils import metrics as tmmetrics
+
+            m = tmmetrics.GLOBAL_NODE_METRICS
+            if m is None:
+                return
+            m.ingest_batch_size.observe(n)
+            ok = sum(1 for o in out
+                     if not isinstance(o, Exception) and o.is_ok())
+            m.ingest_txs.add(ok, result="ok")
+            m.ingest_txs.add(n - ok, result="reject")
+        except Exception:  # noqa: BLE001 - observability never blocks txs
+            pass
 
     def _make_room_locked(self, tx: bytes, priority: int) -> None:
         """v1 full-pool admission (reference: mempool/v1/mempool.go:505-577):
@@ -319,12 +576,30 @@ class Mempool:
     def _recheck_txs(self) -> None:
         """reference: mempool/v0/clist_mempool.go:641-664; the post-check
         filter applies on recheck too (resCbRecheck -> postCheck), so a
-        max_gas tightened by the applied block evicts over-priced txs."""
-        for k in list(self._txs.keys()):
+        max_gas tightened by the applied block evicts over-priced txs.
+
+        The app round trips ride the batched CheckTx path (ONE
+        RequestCheckTxBatch for the whole pool, docs/INGEST.md); the
+        eviction replay below is unchanged, so recheck survivors are
+        bit-identical to the serial loop. A batch-dispatch failure (or a
+        pre-batch remote app) degrades to the per-tx loop."""
+        keys = list(self._txs.keys())
+        responses = None
+        if len(keys) > 1 and getattr(self.app, "check_tx_batch", None) is not None:
+            txs = [self._txs[k].tx for k in keys]
+            try:
+                faults.fire("mempool.ingest")
+                responses = self._batched_app_check(
+                    txs, abci.CHECK_TX_TYPE_RECHECK)
+            except Exception:  # noqa: BLE001 - serial fallback below
+                responses = None
+        for idx, k in enumerate(keys):
             m = self._txs[k]
-            res = self.app.check_tx(
-                abci.RequestCheckTx(tx=m.tx, type=abci.CHECK_TX_TYPE_RECHECK)
-            )
+            if responses is not None:
+                res = responses[idx]
+            else:
+                res = self.app.check_tx(abci.RequestCheckTx(
+                    tx=m.tx, type=abci.CHECK_TX_TYPE_RECHECK))
             ok = res.is_ok()
             if ok and self.post_check is not None:
                 try:

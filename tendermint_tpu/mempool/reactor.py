@@ -2,7 +2,19 @@
 proto/tendermint/mempool/types.proto Message{Txs}).
 
 Each peer gets a gossip thread walking the mempool in insertion order (the
-reference's clist walk), skipping txs the peer already sent us."""
+reference's clist walk), skipping txs the peer already sent us. Two batching
+upgrades over the reference (docs/INGEST.md):
+
+ * RECEIVE: a multi-tx message is admitted through the micro-batched front
+   door (``Mempool.ingest_txs`` -> ``check_tx_batch``) instead of a serial
+   per-tx CheckTx loop — one mempool lock acquisition and one batched ABCI
+   round trip per message (shared with concurrent RPC submissions via the
+   ingest coalescer). The per-error peer-scoring table is IDENTICAL to the
+   serial loop's (regression-gated in tests/test_ingest.py).
+ * SEND: the gossip routine drains ALL currently-eligible txs for a peer
+   into one wire message per tick (the ``Txs`` proto already encodes a
+   repeated field), instead of the reference's one-tx-per-message walk.
+"""
 
 from __future__ import annotations
 
@@ -22,6 +34,9 @@ from tendermint_tpu.p2p.switch import Peer, Reactor
 
 MEMPOOL_CHANNEL = 0x30
 PEER_CATCHUP_SLEEP_S = 0.1
+# Byte cap of one drained gossip message (well under the 10 MiB MConnection
+# MAX_MSG_SIZE; keeps a deep mempool from head-of-line-blocking the channel)
+GOSSIP_DRAIN_MAX_BYTES = 64 * 1024
 
 
 def msg_txs(txs: list[bytes]) -> bytes:
@@ -55,29 +70,43 @@ class MempoolReactor(Reactor):
         if 1 not in f:
             return
         inner = proto.fields(f[1][-1])
-        for tx in inner.get(1, []):
-            try:
-                res = self.mempool.check_tx(tx, sender_peer=peer.id)
-            except ErrTxInCache:
-                pass  # gossip re-delivery: expected, never scored
-            except ErrTxTooLarge:
-                self._score(peer, "tx_too_large")
-            except ErrMempoolIsFull:
-                # full-pool rejects score LIGHTLY: an honest peer gossiping
-                # into a saturated node is normal, a flood of these from
-                # one peer is not (docs/OVERLOAD.md)
-                self._score(peer, "mempool_full")
-            except MempoolError:
-                self._score(peer, "checktx_reject")
-            except Exception:  # noqa: BLE001
-                # an unexpected app/post-check blow-up must never kill the
-                # recv thread — and it is OUR failure, not the peer's:
-                # scoring it would ban every honest gossiper during an
-                # ABCI app outage
-                pass
-            else:
-                if not res.is_ok():
-                    self._score(peer, "checktx_reject")
+        txs = list(inner.get(1, []))
+        if not txs:
+            return
+        try:
+            outcomes = self.mempool.ingest_txs(txs, sender_peer=peer.id)
+        except Exception:  # noqa: BLE001 - an ingest-plumbing blow-up must
+            # never kill the recv thread, and it is OUR failure, not the
+            # peer's (scoring it would ban honest gossipers)
+            return
+        for o in outcomes:
+            self._score_outcome(peer, o)
+
+    def _score_outcome(self, peer: Peer, outcome) -> None:
+        """The per-error scoring table — one place, applied identically to
+        batched and serial admission outcomes (tests/test_ingest.py pins
+        batched == serial attribution)."""
+        if isinstance(outcome, ErrTxInCache):
+            return  # gossip re-delivery: expected, never scored
+        if isinstance(outcome, ErrTxTooLarge):
+            self._score(peer, "tx_too_large")
+            return
+        if isinstance(outcome, ErrMempoolIsFull):
+            # full-pool rejects score LIGHTLY: an honest peer gossiping
+            # into a saturated node is normal, a flood of these from
+            # one peer is not (docs/OVERLOAD.md)
+            self._score(peer, "mempool_full")
+            return
+        if isinstance(outcome, MempoolError):
+            self._score(peer, "checktx_reject")
+            return
+        if isinstance(outcome, Exception):
+            # an unexpected app/post-check blow-up is OUR failure, not the
+            # peer's: scoring it would ban every honest gossiper during an
+            # ABCI app outage
+            return
+        if not outcome.is_ok():
+            self._score(peer, "checktx_reject")
 
     def _score(self, peer: Peer, offense: str) -> None:
         sw = self.switch
@@ -85,26 +114,46 @@ class MempoolReactor(Reactor):
         if board is not None:
             board.record(peer.id, offense)
 
+    def _eligible_batch(self, peer: Peer, sent_seq: int):
+        """Drain every currently-eligible tx for this peer (byte-capped)
+        into one batch. Returns (batch, sent_seq, last_seq, progressed):
+        ``sent_seq`` advances through a leading run of txs the peer
+        already knows (safe even if the send fails — there is nothing
+        pending before them); ``last_seq`` is where the cursor lands if
+        the whole batch sends."""
+        batch: list[bytes] = []
+        batch_bytes = 0
+        progressed = False
+        last_seq = sent_seq
+        for m in self.mempool.iter_txs():
+            if m.seq <= sent_seq:
+                continue
+            if peer.id in m.senders:
+                if not batch:
+                    sent_seq = m.seq
+                    progressed = True
+                else:
+                    last_seq = m.seq
+                continue
+            if batch and batch_bytes + len(m.tx) > GOSSIP_DRAIN_MAX_BYTES:
+                break
+            batch.append(m.tx)
+            batch_bytes += len(m.tx)
+            last_seq = m.seq
+        return batch, sent_seq, last_seq, progressed
+
     def _gossip_routine(self, peer: Peer) -> None:
-        """One-tx-at-a-time walk (reference: mempool/v0/reactor.go
-        broadcastTxRoutine)."""
+        """Drain-and-coalesce walk: all eligible txs per tick go out as ONE
+        message (the reference's broadcastTxRoutine sends one tx each,
+        mempool/v0/reactor.go)."""
         sent_seq = 0
         try:
             while self._peer_running.get(peer.id) and self.switch is not None:
-                entries = self.mempool.iter_txs()
-                progressed = False
-                for m in entries:
-                    if m.seq <= sent_seq:
-                        continue
-                    if peer.id in m.senders:
-                        sent_seq = m.seq
-                        progressed = True
-                        continue
-                    # don't send txs for future heights the peer can't process yet
-                    if peer.try_send(MEMPOOL_CHANNEL, msg_txs([m.tx])):
-                        sent_seq = m.seq
-                        progressed = True
-                    break
+                batch, sent_seq, last_seq, progressed = self._eligible_batch(
+                    peer, sent_seq)
+                if batch and peer.try_send(MEMPOOL_CHANNEL, msg_txs(batch)):
+                    sent_seq = last_seq
+                    progressed = True
                 if not progressed:
                     time.sleep(PEER_CATCHUP_SLEEP_S)
         except Exception as e:  # noqa: BLE001 - gossip ends like a

@@ -453,6 +453,10 @@ class Node:
         self.switch.stop()
         if getattr(self, "signer_endpoint", None) is not None:
             self.signer_endpoint.close()
+        # release the ingest coalescer's executor thread (it holds strong
+        # mempool/app refs; fabric churn would otherwise leak one parked
+        # thread per stopped node, docs/INGEST.md)
+        self.mempool._ingest.stop()
         self.proxy_app.stop()
 
     def _metrics_sampler(self) -> None:

@@ -46,10 +46,25 @@ class _TxAdmissionGate:
             if self._inflight >= self.limit:
                 if board is not None:
                     board.count_shed("rpc_tx")
+                self._count_shed_metric()
                 raise ErrOverloaded(
                     f"node overloaded: {self._inflight} broadcast_tx "
                     f"requests in flight (limit {self.limit}); retry later")
             self._inflight += 1
+
+    @staticmethod
+    def _count_shed_metric() -> None:
+        """The ingest shed/reject split (docs/INGEST.md): gate sheds land
+        in the pre-seeded ingest_txs_total{result="shed"} counter next to
+        the batch path's ok/reject tallies."""
+        try:
+            from tendermint_tpu.utils import metrics as tmmetrics
+
+            m = tmmetrics.GLOBAL_NODE_METRICS
+            if m is not None:
+                m.ingest_txs.add(1, result="shed")
+        except Exception:  # noqa: BLE001 - metrics never block shedding
+            pass
 
     def release(self) -> None:
         if self.limit <= 0:
@@ -76,6 +91,18 @@ def _tx_gate(env) -> _TxAdmissionGate:
 
 def _node_scoreboard(env):
     return getattr(getattr(env.node, "switch", None), "scoreboard", None)
+
+
+def _mempool_submit(env, raw: bytes):
+    """Route a broadcast_tx through the micro-batched ingest front door
+    (docs/INGEST.md) when the mempool has one: concurrent handler threads
+    share batched CheckTx dispatches while each still holds its own
+    admission-gate slot. Falls back to plain check_tx for mempool fakes."""
+    mp = env.node.mempool
+    fn = getattr(mp, "ingest_tx", None)
+    if fn is None:
+        return mp.check_tx(raw)
+    return fn(raw)
 
 
 def _b64(b: bytes) -> str:
@@ -500,7 +527,7 @@ def broadcast_tx_async(env, tx):
 
 def _check_tx_quiet(env, raw, gate):
     try:
-        env.node.mempool.check_tx(raw)
+        _mempool_submit(env, raw)
     except Exception:  # noqa: BLE001
         pass
     finally:
@@ -512,7 +539,7 @@ def broadcast_tx_sync(env, tx):
     gate = _tx_gate(env)
     gate.acquire(_node_scoreboard(env))  # ErrOverloaded propagates, typed
     try:
-        res = env.node.mempool.check_tx(raw)
+        res = _mempool_submit(env, raw)
         return {"code": res.code, "data": _b64(res.data), "log": res.log,
                 "codespace": res.codespace, "hash": _hex(tx_hash(raw))}
     except Exception as e:  # noqa: BLE001
@@ -543,7 +570,7 @@ def broadcast_tx_commit(env, tx):
         raise
     try:
         try:
-            check = env.node.mempool.check_tx(raw)
+            check = _mempool_submit(env, raw)
         finally:
             gate.release()
         if not check.is_ok():

@@ -86,6 +86,9 @@ CANONICAL_SITES: dict[str, str] = {
                 "(drop/delay/disconnect)",
     "p2p.dial": "Transport.dial of an outbound peer (raise/delay)",
     "abci.call": "one ABCI socket round trip (raise/delay/crash)",
+    "mempool.ingest": "one batched CheckTx dispatch of the ingestion front "
+                      "door (mempool check_tx_batch + the batched recheck); "
+                      "failures degrade to the serial per-tx CheckTx loop",
     "ops.ed25519.device": "ed25519 batch-verifier device dispatch; failures "
                           "trip the circuit breaker onto the host fallback",
     "ops.sr25519.device": "sr25519 batch-verifier device dispatch (twin "

@@ -231,6 +231,21 @@ class NodeMetrics:
         # mempool
         self.mempool_size = r.gauge("mempool", "size", "Number of uncommitted txs.")
         self.mempool_failed_txs = r.counter("mempool", "failed_txs", "Rejected txs.")
+        # tx ingestion front door (mempool/ingest.py, docs/INGEST.md)
+        self.ingest_batch_size = r.histogram(
+            "mempool", "ingest_batch_size",
+            "Txs per batched CheckTx dispatch through the ingest front "
+            "door (mempool check_tx_batch).",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+        self.ingest_coalesced = r.counter(
+            "mempool", "ingest_coalesced_total",
+            "Txs that shared an ingest batch with at least one other "
+            "concurrent submission (the coalescer's win counter).")
+        self.ingest_txs = r.counter(
+            "mempool", "ingest_txs_total",
+            "Front-door tx admissions by result: ok / reject (CheckTx or "
+            "mempool verdict) / shed (the rpc_tx admission gate).",
+            labels=("result",))
         # p2p
         self.peers = r.gauge("p2p", "peers", "Number of connected peers.")
         self.peer_receive_bytes = r.counter(
@@ -301,6 +316,13 @@ class NodeMetrics:
         for ch in ("vote", "proposal", "block_part", "rpc_tx"):
             self.shed.add(0.0, channel=ch)
         self.rate_limited.add(0.0, peer="", channel="")
+        # ingest front door: the result label universe is closed by
+        # construction (docs/INGEST.md), seed it fully; the batch-size
+        # histogram scrapes explicit zeros like the phase histogram
+        self.ingest_batch_size.seed()
+        self.ingest_coalesced.add(0.0)
+        for result in ("ok", "reject", "shed"):
+            self.ingest_txs.add(0.0, result=result)
         # p2p byte counters follow the same convention (chID values are
         # bounded by the node's channel table, first traffic creates them)
         self.peer_receive_bytes.add(0.0, chID="")

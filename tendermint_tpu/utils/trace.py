@@ -80,6 +80,12 @@ CANONICAL_SPANS = {
     "fastsync.apply": "block save + ABCI apply of a fast-synced height",
     # tx front door + gossip plane
     "mempool.check_tx": "ABCI CheckTx round trip of one tx",
+    "mempool.ingest_batch": "one batched ABCI CheckTxBatch dispatch of the "
+                            "ingest front door (span; n= txs)",
+    "mempool.ingest_coalesce": "ingest coalescer shared-batch marker "
+                               "(requests= txs per batch)",
+    "mempool.ingest_wait": "submit->resolve wait of one tx through the "
+                           "ingest coalescer",
     "p2p.send": "message queued to a peer channel (mark)",
     "p2p.recv": "message delivered to a reactor (span over on_receive)",
 }
@@ -90,7 +96,8 @@ CANONICAL_SPANS = {
 MIRRORED_SPANS = (
     "verify.host_prep", "verify.queue", "verify.readback", "verify.replay",
     "verify.shard_dispatch", "consensus.vote_drain", "consensus.store_save",
-    "consensus.abci_apply", "mempool.check_tx",
+    "consensus.abci_apply", "mempool.check_tx", "mempool.ingest_batch",
+    "mempool.ingest_wait",
 )
 _MIRROR_SET = frozenset(MIRRORED_SPANS)
 

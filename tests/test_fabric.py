@@ -99,12 +99,13 @@ def test_budget_formula_arithmetic(tmp_path):
     c.nodes = {0: _FN([1, 2, 3]), 1: _FN([0, 2, 3]),
                2: _FN([0, 1, 3]), 3: _FN([0, 1, 2])}
     per_peer = fabric.PER_PEER_THREADS + fabric.PER_PEER_THREADS_MEMPOOL
-    per_node = fabric.NODE_BASE_THREADS + 1
+    per_node = fabric.NODE_BASE_THREADS + 1 + fabric.NODE_THREADS_INGEST
     assert c.expected_thread_budget() == 4 * per_node + 12 * per_peer
     assert c.expected_fd_budget() == 6 * fabric.FDS_PER_LINK + 4 * fabric.FDS_PER_NODE + 16
     c.mempool_broadcast = False
     assert c.expected_thread_budget() == (
-        4 * fabric.NODE_BASE_THREADS + 12 * fabric.PER_PEER_THREADS)
+        4 * (fabric.NODE_BASE_THREADS + fabric.NODE_THREADS_INGEST)
+        + 12 * fabric.PER_PEER_THREADS)
 
 
 def test_small_cluster_commits_within_budget(tmp_path):

@@ -1,0 +1,772 @@
+"""ISSUE 12: the micro-batched tx ingestion front door (docs/INGEST.md).
+
+Batch-vs-serial admission equivalence (verdicts, mempool contents, v1
+priority order, recheck survivors, app state), the ingest coalescer, the
+batched gossip receive with its preserved scoring table, the drain-all
+gossip send, the ABCI CheckTxBatch wire/transport seam with its
+pre-batch-server fallback, fault-injection degradation, and the overload
+composition (a flood through the batched front door still sheds at the
+gate and bans the flooder).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.mempool.mempool import (
+    ErrMempoolIsFull,
+    ErrTxInCache,
+    ErrTxTooLarge,
+    Mempool,
+    MempoolError,
+)
+from tendermint_tpu.utils import peerscore
+
+
+class PricedApp(abci.Application):
+    """Prices txs by their last byte; rejects b'bad*'; records every
+    CheckTx it observes (batch calls ride the base-class loop shim, so
+    `checked` is the per-tx observation multiset either way)."""
+
+    def __init__(self, reject_prefix: bytes = b"bad"):
+        self.reject_prefix = reject_prefix
+        self.checked: list[bytes] = []
+        self.batch_calls = 0
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        self.checked.append(bytes(req.tx))
+        if req.tx.startswith(self.reject_prefix):
+            return abci.ResponseCheckTx(code=1, log="rejected")
+        return abci.ResponseCheckTx(code=0, priority=req.tx[-1] if req.tx else 0,
+                                    gas_wanted=1)
+
+    def check_tx_batch(self, req: abci.RequestCheckTxBatch) -> abci.ResponseCheckTxBatch:
+        self.batch_calls += 1
+        return super().check_tx_batch(req)
+
+
+def _verdict(o) -> str:
+    if isinstance(o, Exception):
+        return type(o).__name__
+    return "ok" if o.is_ok() else f"reject:{o.code}"
+
+
+def _seeded_universe(n: int, seed: int = 42) -> list[bytes]:
+    rng = random.Random(seed)
+    universe: list[bytes] = []
+    for i in range(n):
+        r = rng.random()
+        if r < 0.15:
+            universe.append(b"bad-%d-" % i + bytes([rng.randrange(1, 256)]))
+        elif r < 0.25:
+            universe.append(b"L" * 300)  # oversize for max_tx_bytes=256
+        elif r < 0.38 and universe:
+            universe.append(universe[rng.randrange(len(universe))])
+        else:
+            universe.append(b"kv-%d=" % i + bytes([rng.randrange(1, 256)]))
+    return universe
+
+
+def _serial_outcomes(mp: Mempool, txs, senders=None) -> list:
+    out = []
+    for i, tx in enumerate(txs):
+        try:
+            out.append(mp.check_tx(tx, senders[i] if senders else ""))
+        except Exception as e:  # noqa: BLE001 - the outcome under test
+            out.append(e)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# check_tx_batch == the serial loop
+# ---------------------------------------------------------------------------
+
+
+def test_batch_matches_serial_on_seeded_universe():
+    universe = _seeded_universe(90)
+    senders = ["p%d" % (i % 3) for i in range(len(universe))]
+    a1, a2 = PricedApp(), PricedApp()
+    m1 = Mempool(a1, version="v1", max_tx_bytes=256)
+    m2 = Mempool(a2, version="v1", max_tx_bytes=256)
+    o1 = _serial_outcomes(m1, universe, senders)
+    o2 = m2.check_tx_batch(list(universe), list(senders))
+    assert [_verdict(x) for x in o1] == [_verdict(x) for x in o2]
+    assert [t.tx for t in m1.iter_txs()] == [t.tx for t in m2.iter_txs()]
+    assert m1.reap_max_txs(-1) == m2.reap_max_txs(-1)  # priority order
+    assert m1.reap_max_bytes_max_gas(10_000, -1) == \
+        m2.reap_max_bytes_max_gas(10_000, -1)
+    assert sorted(a1.checked) == sorted(a2.checked)  # app state
+    # sender attribution landed on the admitted entries
+    for t1, t2 in zip(m1.iter_txs(), m2.iter_txs()):
+        assert t1.senders == t2.senders
+
+
+def test_batch_matches_serial_v0_reject_when_full():
+    a1, a2 = PricedApp(), PricedApp()
+    m1 = Mempool(a1, version="v0", max_txs=3)
+    m2 = Mempool(a2, version="v0", max_txs=3)
+    txs = [b"f%d=" % i + bytes([i + 1]) for i in range(8)]
+    o1 = _serial_outcomes(m1, txs)
+    o2 = m2.check_tx_batch(list(txs))
+    assert [_verdict(x) for x in o1] == [_verdict(x) for x in o2]
+    assert [_verdict(x) for x in o2][3:] == ["ErrMempoolIsFull"] * 5
+    assert [t.tx for t in m1.iter_txs()] == [t.tx for t in m2.iter_txs()]
+    # full-rejected txs left the cache on both paths: a later retry works
+    m1.update(1, txs[:3])
+    m2.update(1, txs[:3])
+    assert m1.check_tx(txs[5]).is_ok()
+    assert not isinstance(m2.check_tx_batch([txs[5]])[0], Exception)
+
+
+def test_batch_matches_serial_v1_priority_eviction():
+    a1, a2 = PricedApp(), PricedApp()
+    m1 = Mempool(a1, version="v1", max_txs=3)
+    m2 = Mempool(a2, version="v1", max_txs=3)
+    txs = [b"e%d=" % i + bytes([p])
+           for i, p in enumerate([5, 3, 9, 1, 200, 2, 250])]
+    o1 = _serial_outcomes(m1, txs)
+    o2 = m2.check_tx_batch(list(txs))
+    assert [_verdict(x) for x in o1] == [_verdict(x) for x in o2]
+    assert m1.reap_max_txs(-1) == m2.reap_max_txs(-1)
+    # the high-priority latecomers evicted the low-priority residents
+    assert m2.reap_max_txs(-1)[0][-1] == 250
+
+
+def test_duplicate_of_invalid_tx_within_one_batch():
+    """Serial: an app-rejected tx is dropped from the cache, so its later
+    duplicate reaches the app AGAIN. The batch pre-filter marks the dup as
+    cache-expected; the replay detects the un-cached earlier copy and
+    falls back to a serial app call at the dup's exact serial position."""
+    a1, a2 = PricedApp(), PricedApp()
+    m1 = Mempool(a1, version="v1")
+    m2 = Mempool(a2, version="v1")
+    txs = [b"bad-dup\x05", b"ok-1\x07", b"bad-dup\x05", b"ok-1\x07"]
+    o1 = _serial_outcomes(m1, txs)
+    o2 = m2.check_tx_batch(list(txs))
+    assert [_verdict(x) for x in o1] == [_verdict(x) for x in o2] == \
+        ["reject:1", "ok", "reject:1", "ErrTxInCache"]
+    assert sorted(a1.checked) == sorted(a2.checked)
+    assert a1.checked.count(b"bad-dup\x05") == 2  # app saw the dup twice
+
+
+def test_batch_app_exception_is_the_per_tx_outcome():
+    class Boom(PricedApp):
+        def check_tx(self, req):
+            if req.tx.startswith(b"boom"):
+                raise RuntimeError("app crashed")
+            return super().check_tx(req)
+
+    m1 = Mempool(Boom(), version="v1")
+    m2 = Mempool(Boom(), version="v1")
+    txs = [b"ok-a\x01", b"boom-b\x02", b"ok-c\x03"]
+    o1 = _serial_outcomes(m1, txs)
+    o2 = m2.check_tx_batch(list(txs))
+    assert [_verdict(x) for x in o1] == [_verdict(x) for x in o2] == \
+        ["ok", "RuntimeError", "ok"]
+    assert [t.tx for t in m1.iter_txs()] == [t.tx for t in m2.iter_txs()]
+
+
+def test_batch_post_check_filter_applies_identically():
+    def post(tx, res):
+        if res.gas_wanted > 0 and tx.startswith(b"gassy"):
+            raise MempoolError("post-check: too much gas")
+
+    a1, a2 = PricedApp(), PricedApp()
+    m1 = Mempool(a1, version="v1")
+    m2 = Mempool(a2, version="v1")
+    m1.post_check = post
+    m2.post_check = post
+    txs = [b"ok-a\x01", b"gassy-b\x02", b"ok-c\x03"]
+    o1 = _serial_outcomes(m1, txs)
+    o2 = m2.check_tx_batch(list(txs))
+    assert [_verdict(x) for x in o1] == [_verdict(x) for x in o2] == \
+        ["ok", "MempoolError", "ok"]
+    assert [t.tx for t in m1.iter_txs()] == [t.tx for t in m2.iter_txs()]
+
+
+def test_recheck_rides_batched_path_with_identical_survivors():
+    class FlipApp(PricedApp):
+        """Rejects b'flip*' only on RECHECK — the committed block
+        invalidated them (the reference's recheck eviction shape)."""
+
+        def check_tx(self, req):
+            self.checked.append(bytes(req.tx))
+            if (req.type == abci.CHECK_TX_TYPE_RECHECK
+                    and req.tx.startswith(b"flip")):
+                return abci.ResponseCheckTx(code=2, log="stale")
+            return abci.ResponseCheckTx(code=0, priority=1)
+
+    a1, a2 = FlipApp(), FlipApp()
+    m1 = Mempool(a1, version="v0")
+    m2 = Mempool(a2, version="v0")
+    txs = [b"keep-1", b"flip-2", b"keep-3", b"flip-4", b"keep-5"]
+    for tx in txs:
+        m1.check_tx(tx)
+    assert not any(isinstance(o, Exception)
+                   for o in m2.check_tx_batch(list(txs)))
+    with m1._mtx:
+        m1.update(1, [])  # no committed txs: pure recheck
+    before_batches = a2.batch_calls
+    with m2._mtx:
+        m2.update(1, [])
+    assert a2.batch_calls == before_batches + 1  # ONE batched recheck
+    assert [t.tx for t in m1.iter_txs()] == [t.tx for t in m2.iter_txs()] \
+        == [b"keep-1", b"keep-3", b"keep-5"]
+
+
+def test_batch_dispatch_fault_degrades_to_serial(monkeypatch):
+    from tendermint_tpu.utils import faults
+
+    app = PricedApp()
+    mp = Mempool(app, version="v1")
+    faults.configure(["mempool.ingest:raise"], seed=3)
+    try:
+        out = mp.check_tx_batch([b"ok-a\x01", b"bad-b\x02", b"ok-c\x03"])
+    finally:
+        faults.clear()
+    assert [_verdict(x) for x in out] == ["ok", "reject:1", "ok"]
+    assert app.batch_calls == 0  # the batched dispatch never succeeded
+    assert len(app.checked) == 3  # the serial degradation did the work
+
+
+# ---------------------------------------------------------------------------
+# The ingest coalescer
+# ---------------------------------------------------------------------------
+
+
+def test_coalescer_shares_batches_across_concurrent_submitters(monkeypatch):
+    monkeypatch.setenv("TMTPU_INGEST_WINDOW_US", "100000")
+    app = PricedApp()
+    mp = Mempool(app, version="v1")
+    results: dict[int, object] = {}
+    barrier = threading.Barrier(12)
+
+    def submit(i):
+        try:
+            barrier.wait()
+            results[i] = mp.ingest_tx(b"conc-%d=" % i + bytes([i + 1]))
+        except Exception as e:  # noqa: BLE001 - asserted below
+            results[i] = e
+
+    threads = [threading.Thread(target=submit, args=(i,)) for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert all(not isinstance(r, Exception) and r.is_ok()
+               for r in results.values())
+    assert mp.size() == 12
+    co = mp._ingest
+    assert co.requests == 12
+    assert co.max_coalesced >= 2, "no coalescing observed"
+    assert app.batch_calls == co.batches < 12
+
+
+def test_ingest_disabled_restores_serial_path(monkeypatch):
+    monkeypatch.setenv("TMTPU_INGEST", "0")
+    app = PricedApp()
+    mp = Mempool(app, version="v1")
+    res = mp.ingest_tx(b"serial-1\x05")
+    assert res.is_ok() and mp.size() == 1
+    with pytest.raises(ErrTxInCache):
+        mp.ingest_tx(b"serial-1\x05")
+    outcomes = mp.ingest_txs([b"serial-2\x06", b"serial-1\x05"])
+    assert _verdict(outcomes[0]) == "ok"
+    assert isinstance(outcomes[1], ErrTxInCache)
+    assert app.batch_calls == 0  # never touched the batch seam
+    assert mp._ingest.requests == 0  # nor the coalescer
+
+
+def test_ingest_tx_raises_exactly_like_check_tx():
+    mp = Mempool(PricedApp(), version="v1", max_tx_bytes=16)
+    with pytest.raises(ErrTxTooLarge):
+        mp.ingest_tx(b"x" * 64)
+    assert mp.ingest_tx(b"ok\x05").is_ok()
+    with pytest.raises(ErrTxInCache):
+        mp.ingest_tx(b"ok\x05")
+
+
+def test_coalescer_executor_survives_mempool_blowup(monkeypatch):
+    mp = Mempool(PricedApp(), version="v1")
+
+    calls = {"n": 0}
+    real = mp.check_tx_batch
+
+    def flaky(txs, senders=None, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient ingest blow-up")
+        return real(txs, senders, **kw)
+
+    mp.check_tx_batch = flaky
+    with pytest.raises(RuntimeError, match="transient"):
+        mp.ingest_tx(b"doomed\x01")
+    # the executor shielded the crash: the next submission still works
+    assert mp.ingest_tx(b"fine\x02").is_ok()
+
+
+def test_coalescer_stop_releases_thread_and_restarts_on_submit():
+    mp = Mempool(PricedApp(), version="v1")
+    assert mp.ingest_tx(b"pre-stop\x05").is_ok()
+    co = mp._ingest
+    th = co._thread
+    assert th is not None and th.is_alive()
+    co.stop()
+    th.join(5)
+    assert not th.is_alive()  # the node-teardown path: no parked leak
+    # a later submission simply restarts the executor
+    assert mp.ingest_tx(b"post-stop\x06").is_ok()
+    assert co._thread is not th and co._thread.is_alive()
+
+
+def test_submit_immediately_after_stop_cannot_strand_a_waiter():
+    """The stop()/submit() race: a submission racing node teardown must
+    land in a FRESH executor generation, never behind the old queue's
+    shutdown sentinel (where it would hang its RPC handler forever)."""
+    mp = Mempool(PricedApp(), version="v1")
+    assert mp.ingest_tx(b"warm\x05").is_ok()
+    co = mp._ingest
+    for i in range(5):
+        co.stop()  # no submit between stop and the next ingest_tx:
+        # the very next submission must still resolve promptly
+        assert mp.ingest_tx(b"race-%d\x06" % i).is_ok()
+    co.stop()
+    co.stop()  # idempotent: double-stop must not wedge a later restart
+    assert mp.ingest_tx(b"after-double-stop\x07").is_ok()
+
+
+def test_batched_app_check_chunks_under_byte_cap():
+    """A batch whose payload exceeds BATCH_MAX_BYTES must split into
+    several RequestCheckTxBatch round trips (never one wire-cap-busting
+    message), with responses still order-aligned."""
+
+    class SizedApp(PricedApp):
+        def __init__(self):
+            super().__init__()
+            self.batch_sizes = []
+
+        def check_tx_batch(self, req):
+            self.batch_sizes.append(sum(len(t) for t in req.txs))
+            return super().check_tx_batch(req)
+
+    app = SizedApp()
+    mp = Mempool(app, version="v1", max_tx_bytes=1 << 20,
+                 max_txs_bytes=1 << 30)
+    mp.BATCH_MAX_BYTES = 4096  # instance override: keep the test tiny
+    txs = [b"C" * 1500 + b"-%d\x05" % i for i in range(8)]
+    out = mp.check_tx_batch(list(txs))
+    assert all(not isinstance(o, Exception) and o.is_ok() for o in out)
+    assert len(app.batch_sizes) > 1  # it chunked
+    assert all(s <= 4096 for s in app.batch_sizes)
+    assert mp.size() == 8
+
+
+# ---------------------------------------------------------------------------
+# Gossip receive: batched admission, serial scoring table
+# ---------------------------------------------------------------------------
+
+
+class _FakeSwitchWithBoard:
+    def __init__(self):
+        self.scoreboard = peerscore.PeerScoreBoard()
+
+
+class _FakePeer:
+    def __init__(self, pid):
+        self.id = pid
+        self.sent = []
+
+    def try_send(self, ch_id, msg):
+        self.sent.append((ch_id, msg))
+        return True
+
+
+def _mixed_gossip_universe():
+    """One multi-tx message exercising every scoring row: oversize,
+    app-reject, in-cache re-delivery (never scored), and admits."""
+    return [b"ok-1\x05", b"x" * 100, b"bad-2\x01", b"ok-1\x05", b"ok-3\x07"]
+
+
+def _offenses(mp_factory, monkeypatch, ingest_on):
+    from tendermint_tpu.mempool.reactor import MempoolReactor, msg_txs
+
+    if ingest_on:
+        monkeypatch.delenv("TMTPU_INGEST", raising=False)
+    else:
+        monkeypatch.setenv("TMTPU_INGEST", "0")
+    mp = mp_factory()
+    r = MempoolReactor(mp, broadcast=False)
+    r.switch = _FakeSwitchWithBoard()
+    peer = _FakePeer("gossiper01")
+    r.receive(0x30, peer, msg_txs(_mixed_gossip_universe()))
+    # and a second delivery: everything now in-cache -> no new offenses
+    r.receive(0x30, peer, msg_txs([b"ok-1\x05", b"ok-3\x07"]))
+    return dict(r.switch.scoreboard.describe()["offenses"]), mp
+
+
+def test_gossip_receive_batched_scoring_equals_serial(monkeypatch):
+    def factory():
+        return Mempool(PricedApp(), version="v1", max_tx_bytes=64)
+
+    off_batched, mp_b = _offenses(factory, monkeypatch, ingest_on=True)
+    off_serial, mp_s = _offenses(factory, monkeypatch, ingest_on=False)
+    assert off_batched == off_serial
+    assert off_batched["gossiper01:tx_too_large"] == 1
+    assert off_batched["gossiper01:checktx_reject"] == 1
+    assert "gossiper01:mempool_full" not in off_batched
+    assert [t.tx for t in mp_b.iter_txs()] == [t.tx for t in mp_s.iter_txs()]
+    # ErrTxInCache was never scored, but the sender was recorded for
+    # gossip suppression on both paths
+    for m in mp_b.iter_txs():
+        assert "gossiper01" in m.senders
+
+
+def test_gossip_receive_full_pool_scores_mempool_full_batched(monkeypatch):
+    from tendermint_tpu.mempool.reactor import MempoolReactor, msg_txs
+
+    monkeypatch.delenv("TMTPU_INGEST", raising=False)
+    mp = Mempool(PricedApp(), version="v0", max_txs=1, max_tx_bytes=64)
+    r = MempoolReactor(mp, broadcast=False)
+    r.switch = _FakeSwitchWithBoard()
+    peer = _FakePeer("flooder01")
+    r.receive(0x30, peer, msg_txs([b"tx-one\x05"]))
+    assert mp.size() == 1
+    # a flood of fresh txs into the full pool, all in ONE message
+    r.receive(0x30, peer, msg_txs([b"tx-flood-%d\x01" % i for i in range(30)]))
+    board = r.switch.scoreboard
+    assert board.describe()["offenses"]["flooder01:mempool_full"] == 30
+    # app blow-up mid-batch: swallowed, unscored, recv thread alive
+    mp.flush()
+    before = board.score("flooder01")
+
+    def boom(req):
+        raise RuntimeError("app crashed")
+
+    mp.app.check_tx = boom
+    r.receive(0x30, peer, msg_txs([b"tx-late\x01"]))
+    assert board.score("flooder01") <= before
+    assert "flooder01:checktx_reject" not in board.describe()["offenses"]
+
+
+def test_flood_through_batched_front_door_bans_flooder(monkeypatch):
+    """Overload composition (docs/OVERLOAD.md): sustained garbage through
+    the batched gossip path crosses the ban threshold exactly as the
+    serial path did — shed/gate behavior unchanged under batching."""
+    from tendermint_tpu.mempool.reactor import MempoolReactor, msg_txs
+
+    monkeypatch.delenv("TMTPU_INGEST", raising=False)
+    mp = Mempool(PricedApp(), version="v0", max_txs=1, max_tx_bytes=64)
+    r = MempoolReactor(mp, broadcast=False)
+    r.switch = _FakeSwitchWithBoard()
+    mp.check_tx(b"resident\x05")
+    peer = _FakePeer("flooder02")
+    board = r.switch.scoreboard
+    # the PR 5 flood shape: oversized txs (tx_too_large, full-size points)
+    # mixed with full-pool garbage, all through batched messages
+    for wave in range(40):
+        r.receive(0x30, peer, msg_txs(
+            [b"X" * 100 for _ in range(8)]
+            + [b"flood-%d-%d\x01" % (wave, i) for i in range(8)]))
+        if "flooder02" in board.describe()["banned"]:
+            break
+    assert "flooder02" in board.describe()["banned"]
+    assert board.is_banned("flooder02")
+    # ...while the honest pool resident is untouched
+    assert [m.tx for m in mp.iter_txs()] == [b"resident\x05"]
+
+
+def test_rpc_gate_sheds_flood_through_batched_front_door():
+    """The admission gate holds one slot per batch-member: a flood beyond
+    the inflight limit is refused with the typed overload error while the
+    inflight members complete through the coalesced path."""
+    import base64
+
+    from tendermint_tpu.rpc import core as rpc_core
+
+    release = threading.Event()
+
+    class SlowApp(PricedApp):
+        def check_tx(self, req):
+            release.wait(10)
+            return super().check_tx(req)
+
+    class _Cfg:
+        class rpc:
+            unsafe = True
+            max_broadcast_tx_inflight = 2
+
+    class _Node:
+        config = _Cfg()
+        mempool = Mempool(SlowApp(), version="v1")
+        switch = None
+
+    class _Env:
+        node = _Node()
+
+        def __init__(self):
+            self.event_bus = None
+
+    env = _Env()
+    results = []
+
+    def tx(s):
+        return base64.b64encode(s).decode()
+
+    threads = [threading.Thread(
+        target=lambda i=i: results.append(
+            rpc_core.broadcast_tx_sync(env, tx(b"held-%d\x05" % i))),
+        daemon=True) for i in range(2)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        gate = getattr(env.node, "_rpc_tx_gate", None)
+        if gate is not None and gate._inflight >= 2:
+            break
+        time.sleep(0.005)
+    # both slots held inside the coalesced CheckTx: the flood is SHED
+    with pytest.raises(rpc_core.ErrOverloaded):
+        rpc_core.broadcast_tx_sync(env, tx(b"flood\x05"))
+    release.set()
+    for t in threads:
+        t.join(10)
+    assert len(results) == 2 and all(r["code"] == 0 for r in results)
+
+
+# ---------------------------------------------------------------------------
+# Gossip send: drain-all batching
+# ---------------------------------------------------------------------------
+
+
+def test_gossip_send_drains_all_eligible_txs_into_one_message():
+    from tendermint_tpu.encoding import proto
+    from tendermint_tpu.mempool.reactor import MempoolReactor
+
+    mp = Mempool(PricedApp(), version="v0")
+    for i in range(7):
+        mp.check_tx(b"g%d=v\x05" % i)
+    # tx 3 came FROM the peer: suppressed, but must not block the rest
+    list(mp.iter_txs())[3].senders.add("peer-x")
+    r = MempoolReactor(mp, broadcast=False)
+    peer = _FakePeer("peer-x")
+    batch, sent_seq, last_seq, progressed = r._eligible_batch(peer, 0)
+    assert batch == [b"g%d=v\x05" % i for i in (0, 1, 2, 4, 5, 6)]
+    assert last_seq == 7 and not progressed
+    # decode the wire message: ONE Txs message carrying the whole batch
+    from tendermint_tpu.mempool.reactor import msg_txs
+
+    f = proto.fields(msg_txs(batch))
+    inner = proto.fields(f[1][-1])
+    assert list(inner.get(1, [])) == batch
+    # nothing eligible left once the cursor lands at last_seq
+    batch2, s2, l2, p2 = r._eligible_batch(peer, last_seq)
+    assert batch2 == [] and not p2
+
+
+def test_gossip_send_leading_known_txs_advance_without_send():
+    from tendermint_tpu.mempool.reactor import MempoolReactor
+
+    mp = Mempool(PricedApp(), version="v0")
+    mp.check_tx(b"from-peer-1\x05")
+    mp.check_tx(b"from-peer-2\x05")
+    for m in mp.iter_txs():
+        m.senders.add("peer-y")
+    r = MempoolReactor(mp, broadcast=False)
+    batch, sent_seq, last_seq, progressed = r._eligible_batch(
+        _FakePeer("peer-y"), 0)
+    assert batch == [] and progressed and sent_seq == 2
+
+
+def test_gossip_send_respects_byte_cap():
+    from tendermint_tpu.mempool import reactor as reactor_mod
+
+    mp = Mempool(PricedApp(), version="v0", max_txs_bytes=1 << 30)
+    big = b"B" * (reactor_mod.GOSSIP_DRAIN_MAX_BYTES // 2 - 16)
+    for i in range(4):
+        mp.check_tx(big + b"-%d\x05" % i)
+    r = reactor_mod.MempoolReactor(mp, broadcast=False)
+    batch, _, last_seq, _ = r._eligible_batch(_FakePeer("peer-z"), 0)
+    assert len(batch) == 2  # capped; the rest go out next tick
+    batch2, _, _, _ = r._eligible_batch(_FakePeer("peer-z"), last_seq)
+    assert len(batch2) == 2
+
+
+def test_gossip_routine_thread_sends_batched_message():
+    from tendermint_tpu.mempool.reactor import MEMPOOL_CHANNEL, MempoolReactor
+
+    mp = Mempool(PricedApp(), version="v0")
+    for i in range(5):
+        mp.check_tx(b"thread-%d\x05" % i)
+    r = MempoolReactor(mp, broadcast=True)
+    r.switch = _FakeSwitchWithBoard()
+    peer = _FakePeer("peer-t")
+    r.add_peer(peer)
+    deadline = time.monotonic() + 5
+    while not peer.sent and time.monotonic() < deadline:
+        time.sleep(0.005)
+    r.remove_peer(peer, None)
+    assert peer.sent, "gossip routine never sent"
+    ch, msg = peer.sent[0]
+    assert ch == MEMPOOL_CHANNEL
+    from tendermint_tpu.encoding import proto
+
+    inner = proto.fields(proto.fields(msg)[1][-1])
+    assert list(inner.get(1, [])) == [b"thread-%d\x05" % i for i in range(5)]
+
+
+# ---------------------------------------------------------------------------
+# ABCI transport seam
+# ---------------------------------------------------------------------------
+
+
+def test_wire_codec_check_tx_batch_round_trip():
+    from tendermint_tpu.abci import wire
+
+    req = abci.RequestCheckTxBatch(txs=[b"a", b"bb", b""], type=1)
+    kind, back = wire.decode_request(wire.encode_request("check_tx_batch", req))
+    assert kind == "check_tx_batch" and back == req
+    resp = abci.ResponseCheckTxBatch(responses=[
+        abci.ResponseCheckTx(code=0, priority=7, sender="s", gas_wanted=2),
+        abci.ResponseCheckTx(code=5, log="no", codespace="mempool"),
+    ])
+    kind, back = wire.decode_response(wire.encode_response("check_tx_batch", resp))
+    assert kind == "check_tx_batch" and back == resp
+    kind, back = wire.decode_response(
+        wire.encode_response("check_tx_batch", abci.ResponseCheckTxBatch()))
+    assert back == abci.ResponseCheckTxBatch()
+
+
+def test_socket_transport_batch_round_trip_and_fallback():
+    from tendermint_tpu.abci.client import ABCISocketClient
+    from tendermint_tpu.abci.server import ABCIServer
+
+    app = PricedApp()
+    server = ABCIServer(app, "tcp://127.0.0.1:0")
+    server.start()
+    try:
+        cli = ABCISocketClient(server.addr)
+        assert cli._batch_checktx is None  # unprobed
+        out = cli.check_tx_batch(abci.RequestCheckTxBatch(
+            txs=[b"ok\x07", b"bad\x01", b"x\x09"]))
+        assert cli._batch_checktx is True  # the empty probe succeeded
+        assert app.batch_calls == 2  # probe + the real batch
+        assert [r.code for r in out.responses] == [0, 1, 0]
+        assert [r.priority for r in out.responses] == [7, 0, 9]
+        # the pre-batch-server degradation: serial loop, same responses
+        cli._batch_checktx = False
+        out2 = cli.check_tx_batch(abci.RequestCheckTxBatch(
+            txs=[b"ok\x07", b"bad\x01"]))
+        assert [r.code for r in out2.responses] == [0, 1]
+        cli.close()
+    finally:
+        server.stop()
+
+
+def test_socket_app_exception_does_not_disable_batching():
+    """An app blow-up during a batch is an exception RESPONSE, not a
+    pre-batch server: it must propagate (the mempool layer serial-falls-
+    back that one call) WITHOUT pinning the client to the serial loop."""
+    from tendermint_tpu.abci.client import ABCISocketClient
+    from tendermint_tpu.abci.server import ABCIServer
+    from tendermint_tpu.abci.wire import ABCIRemoteError
+
+    class FlakyApp(PricedApp):
+        def __init__(self):
+            super().__init__()
+            self.fail_once = True
+
+        def check_tx_batch(self, req):
+            # req.txs guard: the client's empty support-probe must not
+            # count as the transient failure under test
+            if req.txs and self.fail_once:
+                self.fail_once = False
+                raise RuntimeError("transient app failure")
+            return super().check_tx_batch(req)
+
+    server = ABCIServer(FlakyApp(), "tcp://127.0.0.1:0")
+    server.start()
+    try:
+        cli = ABCISocketClient(server.addr)
+        with pytest.raises(ABCIRemoteError, match="transient"):
+            cli.check_tx_batch(abci.RequestCheckTxBatch(txs=[b"ok\x01"]))
+        assert cli._batch_checktx  # one blip must not cost batching forever
+        out = cli.check_tx_batch(abci.RequestCheckTxBatch(txs=[b"ok\x03"]))
+        assert [r.code for r in out.responses] == [0]
+        cli.close()
+    finally:
+        server.stop()
+
+
+def test_local_client_exposes_check_tx_batch():
+    from tendermint_tpu.abci.proxy import local_app_conns
+
+    conns = local_app_conns(PricedApp())
+    out = conns.mempool.check_tx_batch(abci.RequestCheckTxBatch(
+        txs=[b"ok\x04", b"bad\x01"]))
+    assert [r.code for r in out.responses] == [0, 1]
+
+
+def test_application_shim_preserves_recheck_type():
+    seen = []
+
+    class TypedApp(abci.Application):
+        def check_tx(self, req):
+            seen.append(req.type)
+            return abci.ResponseCheckTx(code=0)
+
+    TypedApp().check_tx_batch(abci.RequestCheckTxBatch(
+        txs=[b"a", b"b"], type=abci.CHECK_TX_TYPE_RECHECK))
+    assert seen == [abci.CHECK_TX_TYPE_RECHECK] * 2
+
+
+# ---------------------------------------------------------------------------
+# Observability
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_spans_are_canonical_and_recorded(monkeypatch):
+    from tendermint_tpu.utils import trace as tmtrace
+
+    for name in ("mempool.ingest_batch", "mempool.ingest_coalesce",
+                 "mempool.ingest_wait"):
+        assert name in tmtrace.CANONICAL_SPANS
+    assert "mempool.ingest_batch" in tmtrace.MIRRORED_SPANS
+    monkeypatch.setenv("TMTPU_INGEST_WINDOW_US", "20000")
+    mp = Mempool(PricedApp(), version="v1")
+    tracer = tmtrace.Tracer(name="ingest-test", enabled=True)
+    mp.tracer = tracer
+    try:
+        assert mp.ingest_tx(b"traced\x05").is_ok()
+    finally:
+        tracer.disable()
+    names = {s.name for s in tracer.dump()}
+    assert {"mempool.ingest_batch", "mempool.ingest_coalesce",
+            "mempool.ingest_wait"} <= names
+
+
+def test_ingest_metrics_preseeded_and_counted():
+    from tendermint_tpu.utils import metrics as tmmetrics
+
+    nm = tmmetrics.NodeMetrics()
+    text = nm.registry.expose()
+    assert 'tendermint_mempool_ingest_txs_total{result="ok"} 0.0' in text
+    assert 'tendermint_mempool_ingest_txs_total{result="reject"} 0.0' in text
+    assert 'tendermint_mempool_ingest_txs_total{result="shed"} 0.0' in text
+    assert "tendermint_mempool_ingest_coalesced_total 0.0" in text
+    assert "tendermint_mempool_ingest_batch_size_count 0" in text
+    prev = tmmetrics.GLOBAL_NODE_METRICS
+    tmmetrics.GLOBAL_NODE_METRICS = nm
+    try:
+        mp = Mempool(PricedApp(), version="v1")
+        mp.check_tx_batch([b"m-ok\x05", b"bad-m\x01"])
+    finally:
+        tmmetrics.GLOBAL_NODE_METRICS = prev
+    text = nm.registry.expose()
+    assert 'tendermint_mempool_ingest_txs_total{result="ok"} 1.0' in text
+    assert 'tendermint_mempool_ingest_txs_total{result="reject"} 1.0' in text
+    assert "tendermint_mempool_ingest_batch_size_count 1" in text
