@@ -21,6 +21,7 @@ from tendermint_tpu.blockchain.pipeline import VerifyAheadPipeline
 from tendermint_tpu.encoding import proto
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.switch import Peer, Reactor
+from tendermint_tpu.store.envelope import CorruptedStoreError
 from tendermint_tpu.types.block import Block
 
 BLOCKCHAIN_CHANNEL = 0x40
@@ -133,6 +134,15 @@ class BlockPool:
                 del self.blocks[h]
             return bad_peer
 
+    def solicited(self, peer_id: str, height: int) -> bool:
+        """True when this pool has an outstanding request for ``height``
+        addressed to ``peer_id`` (mirrors the v2 scheduler's guard: other
+        actors — notably the store repairer — send BlockRequests of their
+        own, and a peer's honest NoBlock answer to one of those must not
+        be punished)."""
+        with self._mtx:
+            return self.requested.get(height) == peer_id
+
     def wanted_requests(self) -> list[tuple[int, str]]:
         """Pick heights to request and a peer for each."""
         with self._mtx:
@@ -163,6 +173,9 @@ class BlockchainReactor(Reactor):
         self.logger = logger
         self.pool = BlockPool(block_store.height + 1)
         self._pipeline = VerifyAheadPipeline()
+        # the node's StoreRepairer (store/repair.py): BlockResponses feed
+        # its fetch waiters, corrupt serving-side loads route to it
+        self.repairer = None
         self._running = False
         self._thread: threading.Thread | None = None
         self._synced = threading.Event()
@@ -188,7 +201,14 @@ class BlockchainReactor(Reactor):
         if 1 in f:  # BlockRequest
             m = proto.fields(f[1][-1])
             height = proto.as_sint64(m.get(1, [0])[-1])
-            block = self.block_store.load_block(height)
+            try:
+                block = self.block_store.load_block(height)
+            except CorruptedStoreError:
+                # thread-crash-surface rule: a rotten record must not kill
+                # this receive path OR be served — the store's repair hook
+                # has already quarantined + scheduled the height; answer
+                # no-block so the peer retries elsewhere meanwhile
+                block = None
             if block is not None:
                 peer.try_send(BLOCKCHAIN_CHANNEL, msg_block_response(block))
             else:
@@ -196,6 +216,9 @@ class BlockchainReactor(Reactor):
         elif 3 in f:  # BlockResponse
             m = proto.fields(f[3][-1])
             block = Block.unmarshal(m.get(1, [b""])[-1])
+            rep = self.repairer
+            if rep is not None:
+                rep.offer_block(peer.id, block)
             self.pool.add_block(peer.id, block)
         elif 4 in f:  # StatusRequest
             peer.try_send(BLOCKCHAIN_CHANNEL,

@@ -32,6 +32,7 @@ from tendermint_tpu.blockchain.reactor import (
 from tendermint_tpu.encoding import proto
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.switch import Peer, Reactor
+from tendermint_tpu.store.envelope import CorruptedStoreError
 from tendermint_tpu.types.block import Block
 
 # states (reference: reactor_fsm.go:22-28)
@@ -79,8 +80,13 @@ class FastSyncFSM:
             self.r.pool.add_block(ev.peer_id, ev.block)
             self._process_ready()
         elif ev.kind == "no_block":
-            # peer advertised a height it can't serve: drop it
-            self.r.drop_peer(ev.peer_id, "no block for advertised height")
+            # peer advertised a height it can't serve: drop it — but only
+            # when the POOL solicited that height from that peer. The store
+            # repairer broadcasts BlockRequests outside the FSM, and an
+            # honest peer answering NoBlock to one of those (pruned below
+            # the height, still syncing) must not be torn down.
+            if self.r.pool.solicited(ev.peer_id, ev.height):
+                self.r.drop_peer(ev.peer_id, "no block for advertised height")
         elif ev.kind == "remove_peer":
             self.r.pool.remove_peer(ev.peer_id)
             if not self.r.pool.peers and self.state == S_WAIT_FOR_BLOCK:
@@ -131,6 +137,7 @@ class BlockchainReactorV1(Reactor):
         self.logger = logger
         self.pool = BlockPool(block_store.height + 1)
         self._pipeline = VerifyAheadPipeline()
+        self.repairer = None  # the node's StoreRepairer (store/repair.py)
         self.fsm = FastSyncFSM(self)
         self._events: queue.Queue = queue.Queue(maxsize=1000)
         self._running = False
@@ -168,7 +175,12 @@ class BlockchainReactorV1(Reactor):
         if 1 in f:  # BlockRequest (serving side, no FSM involvement)
             m = proto.fields(f[1][-1])
             height = proto.as_sint64(m.get(1, [0])[-1])
-            block = self.block_store.load_block(height)
+            try:
+                block = self.block_store.load_block(height)
+            except CorruptedStoreError:
+                # quarantined + scheduled by the store's repair hook; never
+                # serve rot, never kill the receive path (docs/DURABILITY.md)
+                block = None
             if block is not None:
                 peer.try_send(BLOCKCHAIN_CHANNEL, msg_block_response(block))
             else:
@@ -179,8 +191,11 @@ class BlockchainReactorV1(Reactor):
                           height=proto.as_sint64(m.get(1, [0])[-1])))
         elif 3 in f:  # BlockResponse
             m = proto.fields(f[3][-1])
-            self._post(Ev("block", peer_id=peer.id,
-                          block=Block.unmarshal(m.get(1, [b""])[-1])))
+            block = Block.unmarshal(m.get(1, [b""])[-1])
+            rep = self.repairer
+            if rep is not None:
+                rep.offer_block(peer.id, block)
+            self._post(Ev("block", peer_id=peer.id, block=block))
         elif 4 in f:  # StatusRequest
             peer.try_send(BLOCKCHAIN_CHANNEL,
                           msg_status_response(self.block_store.height, self.block_store.base))

@@ -32,6 +32,7 @@ from tendermint_tpu.blockchain.reactor import (
 from tendermint_tpu.encoding import proto
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.switch import Peer, Reactor
+from tendermint_tpu.store.envelope import CorruptedStoreError
 from tendermint_tpu.types.block import Block
 from tendermint_tpu.types.block_id import BlockID
 from tendermint_tpu.types.part_set import PartSet
@@ -271,6 +272,7 @@ class BlockchainReactorV2(Reactor):
         self.logger = logger
         self.scheduler = Scheduler(block_store.height + 1)
         self.processor = Processor(state, block_exec, block_store)
+        self.repairer = None  # the node's StoreRepairer (store/repair.py)
         self._events: queue.Queue = queue.Queue(maxsize=2000)
         self._running = False
         self._thread: threading.Thread | None = None
@@ -302,7 +304,10 @@ class BlockchainReactorV2(Reactor):
         if 1 in f:  # BlockRequest: serving side
             m = proto.fields(f[1][-1])
             height = proto.as_sint64(m.get(1, [0])[-1])
-            block = self.block_store.load_block(height)
+            try:
+                block = self.block_store.load_block(height)
+            except CorruptedStoreError:
+                block = None  # quarantined + scheduled; never serve rot
             if block is not None:
                 peer.try_send(BLOCKCHAIN_CHANNEL, msg_block_response(block))
             else:
@@ -312,8 +317,11 @@ class BlockchainReactorV2(Reactor):
             self._post(EvNoBlock(peer.id, proto.as_sint64(m.get(1, [0])[-1])))
         elif 3 in f:
             m = proto.fields(f[3][-1])
-            self._post(EvBlockResponse(peer.id,
-                                       Block.unmarshal(m.get(1, [b""])[-1])))
+            block = Block.unmarshal(m.get(1, [b""])[-1])
+            rep = self.repairer
+            if rep is not None:
+                rep.offer_block(peer.id, block)
+            self._post(EvBlockResponse(peer.id, block))
         elif 4 in f:
             peer.try_send(BLOCKCHAIN_CHANNEL,
                           msg_status_response(self.block_store.height,

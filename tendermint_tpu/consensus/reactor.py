@@ -21,6 +21,7 @@ from tendermint_tpu.encoding import proto
 from tendermint_tpu.utils.bits import BitArray
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.switch import Peer, Reactor
+from tendermint_tpu.store.envelope import CorruptedStoreError
 from tendermint_tpu.types.block_id import BlockID, PartSetHeader
 from tendermint_tpu.types.part_set import Part, PartSet
 from tendermint_tpu.types.proposal import Proposal
@@ -222,8 +223,12 @@ class ConsensusReactor(Reactor):
             # held belongs to a height fast sync just skipped past, and a
             # stale vote set must never be packed into a future proposal.
             if state.last_block_height > 0:
-                seen = self.cs.block_store.load_seen_commit(
-                    state.last_block_height)
+                try:
+                    seen = self.cs.block_store.load_seen_commit(
+                        state.last_block_height)
+                except CorruptedStoreError:
+                    seen = None  # quarantined; consensus restarts without
+                    # the reconstructed LastCommit (same as missing)
                 if seen is not None and state.last_validators is not None:
                     self.cs.rs.last_commit = commit_to_vote_set(
                         state.chain_id, seen, state.last_validators)
@@ -460,7 +465,10 @@ class ConsensusReactor(Reactor):
         """reference: consensus/reactor.go:631-700. True when a part was
         sent (the caller's loop owns the idle sleep)."""
         prs = ps.prs
-        meta = self.cs.block_store.load_block_meta(prs.height)
+        try:
+            meta = self.cs.block_store.load_block_meta(prs.height)
+        except CorruptedStoreError:
+            return False  # quarantined + repair scheduled by the store hook
         if meta is None:
             return False
         with ps.mtx:
@@ -471,7 +479,12 @@ class ConsensusReactor(Reactor):
         if not want:
             return False
         i = random.choice(want)
-        part = self.cs.block_store.load_block_part(prs.height, i)
+        try:
+            part = self.cs.block_store.load_block_part(prs.height, i)
+        except CorruptedStoreError:
+            # never gossip a rotten part; the repair hook already has the
+            # height, and a healed part flows on a later pass
+            return False
         if part is None:
             return False
         if peer.try_send(DATA_CHANNEL, msg_block_part(prs.height, prs.round, part)):
@@ -525,7 +538,10 @@ class ConsensusReactor(Reactor):
                 return True
         if prs.height < rs.height and prs.height >= max(self.cs.block_store.base, 1):
             # catchup: send precommits from the stored commit
-            commit = self.cs.block_store.load_block_commit(prs.height)
+            try:
+                commit = self.cs.block_store.load_block_commit(prs.height)
+            except CorruptedStoreError:
+                commit = None  # quarantined; repair scheduled
             if commit is not None:
                 with ps.mtx:
                     # EnsureCatchupCommitRound (reference: reactor.go:1120-1140)

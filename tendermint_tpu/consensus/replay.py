@@ -130,9 +130,20 @@ class Handshaker:
     def _replay_range(self, state: State, app, app_height: int, store_height: int) -> State:
         """Replay blocks [app_height+1, store_height] through the app
         (reference: consensus/replay.go:437-530 replayBlocks/replayBlock)."""
+        from tendermint_tpu.store.envelope import CorruptedStoreError
+
         first = max(app_height + 1, self.block_store.base)
         for h in range(first, store_height + 1):
-            block = self.block_store.load_block(h)
+            try:
+                block = self.block_store.load_block(h)
+            except CorruptedStoreError as e:
+                # quarantined by the store hook; replay cannot proceed past
+                # a rotten block the app still needs — fail typed so the
+                # operator (or a statesync re-bootstrap) takes over rather
+                # than crashing on a bare proto error (docs/DURABILITY.md)
+                raise HandshakeError(
+                    f"block at height {h} is corrupt and required for app "
+                    f"replay: {e}") from e
             if block is None:
                 raise HandshakeError(f"missing block at height {h} during replay")
             meta = self.block_store.load_block_meta(h)

@@ -230,7 +230,19 @@ class ConsensusState:
             # reconstruct LastCommit when resuming mid-chain (reference:
             # consensus/state.go:540-570 reconstructLastCommit)
             if state.last_block_height > 0:
-                seen = block_store.load_seen_commit(state.last_block_height)
+                from tendermint_tpu.store.envelope import CorruptedStoreError
+
+                try:
+                    seen = block_store.load_seen_commit(state.last_block_height)
+                except CorruptedStoreError:
+                    # quarantined + repair scheduled by the store hook; the
+                    # canonical commit row (written with block h+1) carries
+                    # the same +2/3, so resume from it when it survives
+                    try:
+                        seen = block_store.load_block_commit(
+                            state.last_block_height)
+                    except CorruptedStoreError:
+                        seen = None  # both rows rotten: fail typed below
                 if seen is None:
                     raise ConsensusError(
                         f"failed to reconstruct last commit; seen commit for height "
@@ -862,7 +874,13 @@ class ConsensusState:
         """reference: consensus/state.go:1040-1053."""
         if height == self.state.initial_height:
             return True
-        last_meta = self.block_store.load_block_meta(height - 1)
+        from tendermint_tpu.store.envelope import CorruptedStoreError
+
+        try:
+            last_meta = self.block_store.load_block_meta(height - 1)
+        except CorruptedStoreError:
+            return True  # quarantined + repair scheduled; propose a proof
+            # block conservatively rather than kill the round routine
         if last_meta is None:
             raise ConsensusError(f"needProofBlock: last block meta for height {height-1} not found")
         return self.state.app_hash != last_meta.header.app_hash

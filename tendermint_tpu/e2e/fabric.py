@@ -459,7 +459,15 @@ class Cluster:
         return False
 
     def block_hash(self, i: int, h: int) -> bytes | None:
-        meta = self.nodes[i].node.block_store.load_block_meta(h)
+        from tendermint_tpu.store.envelope import CorruptedStoreError
+
+        try:
+            meta = self.nodes[i].node.block_store.load_block_meta(h)
+        except CorruptedStoreError:
+            # quarantined + repair scheduled by the node's own hook; the
+            # auditor re-reads this height next sweep (a repaired row
+            # re-enters the agreement check, rot is never "agreed")
+            return None
         return None if meta is None else meta.block_id.hash
 
     def audit_agreement(self, min_height: int = 1) -> int:

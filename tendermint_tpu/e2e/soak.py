@@ -39,6 +39,8 @@ seeded link decisions).
     @18:restart:2                stop + re-boot a node (fast-sync recovery)
     @21:leave:6                  remove a node mid-height
     @24:evidence:3               make node 3 equivocate (double_prevote)
+    @27:bitrot:2:block           flip a seeded bit in node 2's block store
+    @28:bitrot:2:state:truncate  truncate a state-history row at rest
 
 The driver tracks quorum arithmetic: while an installed partition leaves no
 side with >2/3 of the voting power, the auditor is told a stall is EXPECTED
@@ -62,7 +64,7 @@ DEFAULT_DURATION_S = 20.0
 DEFAULT_TOPOLOGY = "k-regular:4"
 
 _KINDS = ("partition", "linkfault", "flood", "join", "join_statesync",
-          "power", "restart", "leave", "evidence")
+          "power", "restart", "leave", "evidence", "bitrot")
 
 
 @dataclass
@@ -129,7 +131,7 @@ class SoakSchedule:
         step = duration_s * 0.7 / slots
         t = duration_s * 0.15
         kinds = ["partition", "linkfault", "join", "power", "flood",
-                 "restart", "evidence"]
+                 "restart", "evidence", "bitrot"]
         if statesync_ok:
             kinds.append("join_statesync")
         for _ in range(slots):
@@ -164,6 +166,15 @@ class SoakSchedule:
             elif kind == "evidence":
                 actions.append(SoakAction(round(t, 1), kind,
                                           str(rng.randrange(nodes))))
+            elif kind == "bitrot":
+                # at-rest corruption of one node's storage plane: the
+                # scrubber must detect it and the repairer heal it with
+                # ZERO auditor violations (docs/DURABILITY.md)
+                target = rng.randrange(nodes)
+                store = rng.choice(("block", "block", "state"))
+                mode = rng.choice(("bitrot", "truncate"))
+                actions.append(SoakAction(round(t, 1), kind,
+                                          f"{target}:{store}:{mode}"))
         return SoakSchedule(actions)
 
 
@@ -471,6 +482,43 @@ class SoakDriver:
             idx = int(a.arg)
             if idx in self.cluster.nodes:
                 self.cluster.install_misbehavior(idx)
+        elif a.kind == "bitrot":
+            parts = a.arg.split(":")
+            idx = int(parts[0])
+            store = parts[1] if len(parts) > 1 else "block"
+            mode = parts[2] if len(parts) > 2 else "bitrot"
+            if idx in self.cluster.nodes:
+                self._apply_bitrot(self.cluster.nodes[idx], store, mode)
+
+    def _apply_bitrot(self, fn, store: str, mode: str) -> None:
+        """At-rest corruption of one committed record on a live node, then
+        a detection scrub whose repairs drain on the node's background
+        repair worker (store/repair.py) — the perturbation the rest of the
+        fault stack could not express: disk rot under traffic."""
+        node = fn.node
+        rng = random.Random(f"soak-bitrot:{self.seed}:{self.fired}")
+        key = None
+        if store == "state":
+            db = node.state_store._db
+            rows = [k for k, _ in db.iterator(b"validatorsKey:",
+                                              b"validatorsKey;")]
+            if rows:
+                key = rng.choice(sorted(rows))
+        else:
+            from tendermint_tpu.store import block_store as bs_mod
+
+            bs = node.block_store
+            db = bs._db
+            if bs.height > bs.base:
+                h = rng.randrange(bs.base, bs.height)  # never the live tip
+                key = rng.choice((bs_mod._meta_key(h), bs_mod._part_key(h, 0),
+                                  bs_mod._seen_commit_key(h)))
+                if db.get(key) is None:
+                    key = bs_mod._meta_key(h)
+        if key is None or db.get(key) is None:
+            return  # nothing committed to rot yet; recorded as fired anyway
+        faults.corrupt_db(db, key, mode=mode, seed=self.seed)
+        node.scrubber().scrub(repairer=node.store_repairer, drain=False)
 
     def _drain_heals(self, now: float) -> None:
         for entry in list(self._pending_heals):

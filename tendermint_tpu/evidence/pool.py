@@ -7,7 +7,9 @@ from __future__ import annotations
 import threading
 
 from tendermint_tpu.encoding import proto
+from tendermint_tpu.store import envelope
 from tendermint_tpu.store.db import DB
+from tendermint_tpu.utils import faults
 from tendermint_tpu.types.evidence import (
     DuplicateVoteEvidence,
     EvidenceError,
@@ -36,6 +38,11 @@ class EvidencePool:
         # votes reported by consensus, to be turned into evidence
         self._consensus_buffer: list[tuple[Vote, Vote]] = []
         self.on_evidence = []  # callbacks(ev) for the reactor broadcast
+        # repair hook (docs/DURABILITY.md): wired by the node to its
+        # StoreRepairer; corrupt rows are also quarantined inline below —
+        # evidence is re-deliverable (gossip) or already decided (a block),
+        # so drop-and-requeue-from-peers IS the repair
+        self.on_corruption = None
         # Monotonic change counter for the pending set / consensus buffer.
         # The per-peer broadcast routines compare it against their last
         # scan instead of re-running the pending_evidence DB iteration
@@ -50,14 +57,38 @@ class EvidencePool:
         self._process_consensus_buffer()
         out = []
         size = 0
-        for _k, v in self._db.iterator(b"p", b"q"):
-            ev = evidence_unmarshal(v)
+        for k, v in list(self._db.iterator(b"p", b"q")):
+            try:
+                ev = self._decode_row(k, v)
+            except envelope.CorruptedStoreError:
+                continue  # quarantined by _decode_row; never gossip rot
+            if ev is None:
+                continue  # drop-rule transient miss: skip, row stays
             sz = len(v)
             if max_bytes >= 0 and size + sz > max_bytes:
                 break
             out.append(ev)
             size += sz
         return out, size
+
+    def _decode_row(self, key: bytes, raw: bytes):
+        """Checked decode of one evidence row: the fault site + envelope +
+        guarded unmarshal, with inline quarantine on detection (evidence is
+        the one store where quarantine IS repair — peers regossip pending
+        evidence, committed evidence lives in blocks). A ``drop``-rule
+        firing returns None — a *transient* read miss, the same semantics
+        every other store gives the rule; the row on disk stays intact."""
+        raw = faults.mutate_value("store.evidence.load", raw)
+        if raw is None:
+            return None
+        try:
+            return envelope.decode(raw, "evidence", key, evidence_unmarshal,
+                                   on_corruption=self.on_corruption)
+        except envelope.CorruptedStoreError as e:
+            envelope.quarantine(self._db, e)
+            envelope.count_repair("evidence")
+            self.version += 1
+            raise
 
     def is_pending(self, ev) -> bool:
         return self._db.has(_pending_key(ev))
@@ -73,7 +104,7 @@ class EvidencePool:
             if self.is_pending(ev) or self.is_committed(ev):
                 return
             self.verify(ev)
-            self._db.set(_pending_key(ev), ev.bytes())
+            self._db.set(_pending_key(ev), envelope.wrap(ev.bytes()))
             self.version += 1
         for cb in self.on_evidence:
             cb(ev)
@@ -106,7 +137,8 @@ class EvidencePool:
                 if ev is not None:
                     with self._mtx:
                         if not self.is_pending(ev) and not self.is_committed(ev):
-                            self._db.set(_pending_key(ev), ev.bytes())
+                            self._db.set(_pending_key(ev),
+                                         envelope.wrap(ev.bytes()))
                             self.version += 1
                     for cb in self.on_evidence:
                         cb(ev)
@@ -247,13 +279,18 @@ class EvidencePool:
         with self._mtx:
             sets, deletes = [], []
             for ev in evidence_list:
-                sets.append((_committed_key(ev), b"\x01"))
+                sets.append((_committed_key(ev), envelope.wrap(b"\x01")))
                 deletes.append(_pending_key(ev))
             self._db.write_batch(sets, deletes)
             # prune expired pending evidence
             params = state.consensus_params.evidence
             for k, v in list(self._db.iterator(b"p", b"q")):
-                ev = evidence_unmarshal(v)
+                try:
+                    ev = self._decode_row(k, v)
+                except envelope.CorruptedStoreError:
+                    continue  # quarantined; nothing left to age out
+                if ev is None:
+                    continue  # transient miss: age it out next update
                 age_blocks = state.last_block_height - ev.height()
                 age_ns = state.last_block_time.unix_ns() - ev.time().unix_ns()
                 if age_blocks > params.max_age_num_blocks and age_ns > params.max_age_duration_ns:

@@ -42,6 +42,7 @@ class EvidenceReactor(Reactor):
 
     def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
         from tendermint_tpu.state.store import StateStoreError
+        from tendermint_tpu.store.envelope import CorruptedStoreError
 
         f = proto.fields(msg_bytes)
         for raw in f.get(1, []):
@@ -49,6 +50,13 @@ class EvidenceReactor(Reactor):
                 ev = evidence_unmarshal(raw)
                 self.pool.add_evidence(ev)
             except EvidenceError:
+                pass
+            except CorruptedStoreError:
+                # verification tripped over OUR rotten state/block record —
+                # the store hook has quarantined + scheduled the repair;
+                # dropping the evidence (it regossips) instead of letting
+                # the error tear the peer down (thread-crash-surface rule,
+                # docs/DURABILITY.md)
                 pass
             except StateStoreError:
                 # Evidence for a height WE don't have state for yet — a

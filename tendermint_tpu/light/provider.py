@@ -112,11 +112,20 @@ class NodeProvider(Provider):
             height = tip
         if height > tip:
             raise ErrHeightTooHigh(f"no block at height {height}")
-        block = self._block_store.load_block(height)
-        commit = self._block_store.load_block_commit(height)
-        if commit is None:
-            # Tip block: only the seen commit exists so far.
-            commit = self._block_store.load_seen_commit(height)
+        from tendermint_tpu.store.envelope import CorruptedStoreError
+
+        try:
+            block = self._block_store.load_block(height)
+            commit = self._block_store.load_block_commit(height)
+            if commit is None:
+                # Tip block: only the seen commit exists so far.
+                commit = self._block_store.load_seen_commit(height)
+        except CorruptedStoreError as e:
+            # quarantined + repair scheduled by the store hook: a light
+            # client / statesync consumer must see a clean not-found (it
+            # retries another provider) rather than rotten bytes
+            raise ErrLightBlockNotFound(
+                f"block at height {height} quarantined: {e}") from e
         if block is None or commit is None:
             raise ErrLightBlockNotFound(f"no block at height {height}")
         try:

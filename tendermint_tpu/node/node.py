@@ -76,12 +76,27 @@ class Node:
         self.state_store = StateStore(new_db(backend, os.path.join(dbdir, "state.db")
                                              if backend != "memdb" else None))
 
-        # genesis + state
+        # genesis + state. The very first state load is guarded: a corrupt
+        # state row is quarantined and rebuilt from the block store when
+        # possible; otherwise the empty state routes this node into the
+        # normal state-sync / fast-sync bootstrap (store/repair.py,
+        # docs/DURABILITY.md) instead of refusing to boot.
+        from tendermint_tpu.store.repair import StoreRepairer, recover_state
+
         self.genesis = genesis if genesis is not None else GenesisDoc.from_file(config.genesis_file())
-        state = self.state_store.load()
+        state = recover_state(self.state_store, self.block_store, logger,
+                              statesync_enabled=config.statesync.enable)
         if state.is_empty():
             state = make_genesis_state(self.genesis)
             self.state_store.save(state)
+
+        # self-healing storage plane: one repairer owns quarantine + the
+        # repair queue; every store's detection hook routes into it
+        self.store_repairer = StoreRepairer(
+            block_store=self.block_store, state_store=self.state_store,
+            chain_id=self.genesis.chain_id, logger=logger)
+        self.block_store.on_corruption = self.store_repairer.note
+        self.state_store.on_corruption = self.store_repairer.note
 
         # app: in-proc object or socket address -> 4-connection proxy
         # (reference: node/node.go:731 createAndStartProxyAppConns)
@@ -141,6 +156,8 @@ class Node:
         from tendermint_tpu.evidence.pool import EvidencePool
 
         self.evidence_pool = EvidencePool(new_db("memdb"), self.state_store, self.block_store)
+        self.store_repairer.evidence_db = self.evidence_pool._db
+        self.evidence_pool.on_corruption = self.store_repairer.note
 
         # block executor
         self.block_exec = BlockExecutor(
@@ -208,6 +225,10 @@ class Node:
         self.bc_reactor = _BCR(
             state, self.block_exec, self.block_store, fast_sync,
             self.consensus_reactor)
+        # BlockResponses feed the repairer's fetch waiters; the repairer's
+        # own requests ride the same 0x40 wire protocol over this switch
+        self.bc_reactor.repairer = self.store_repairer
+        self.store_repairer.switch = self.switch
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
         syncer = None
         if self._statesync_active:
@@ -245,6 +266,10 @@ class Node:
                             if backend != "memdb" else None)
             self.tx_indexer = TxIndexer(idx_db)
             self.block_indexer = BlockIndexer(idx_db)
+            self.tx_indexer.on_corruption = self.store_repairer.note
+            self.block_indexer.on_corruption = self.store_repairer.note
+            self.store_repairer.tx_indexer = self.tx_indexer
+            self.store_repairer.block_indexer = self.block_indexer
             self.indexer_service = IndexerService(
                 self.tx_indexer, self.block_indexer, self.event_bus, logger)
         elif config.tx_index.indexer == "psql":
@@ -308,6 +333,7 @@ class Node:
         self.mempool.tracer = self.tracer
         self.switch.tracer = self.tracer
         self.bc_reactor.tracer = self.tracer
+        self.store_repairer.tracer = self.tracer
 
         self.rpc_server = None
         self._tx_notify_thread = None
@@ -373,6 +399,34 @@ class Node:
                 self.addr_book.add_our_address(
                     NetAddress(self.node_key.id(), host, int(port)))
         self.switch.start()
+        # boot-time integrity scrub (TMTPU_SCRUB_ON_START=0 opts out,
+        # docs/DURABILITY.md), on a background thread: the full walk is
+        # O(chain length) and must not serialize startup. Serving paths
+        # stay safe meanwhile — every read is individually checked, so a
+        # peer asking for a not-yet-scrubbed rotten row gets typed-missing
+        # and the repair hook fires. Repairs drain on the repairer's
+        # background worker once peers connect.
+        from tendermint_tpu.store.scrub import scrub_on_start_enabled
+
+        if scrub_on_start_enabled():
+            import threading
+
+            def _boot_scrub():
+                try:
+                    report = self.scrubber().scrub(
+                        repairer=self.store_repairer, drain=False)
+                    if report.corruptions and self.logger:
+                        self.logger.error(
+                            "startup scrub found corruption; repairs "
+                            "scheduled", corrupt=len(report.corruptions),
+                            checked=report.checked)
+                except Exception as e:  # noqa: BLE001 - the scrub is
+                    # advisory; a failed pass must not take the node down
+                    if self.logger:
+                        self.logger.error("startup scrub failed", err=e)
+
+            threading.Thread(target=_boot_scrub, name="boot-scrub",
+                             daemon=True).start()
         if self.config.p2p.persistent_peers:
             self.switch.add_persistent_peers(
                 self.config.p2p.persistent_peers.split(","))
@@ -627,6 +681,19 @@ class Node:
         self.bc_reactor.switch_to_fast_sync(state)
 
     # --- helpers -----------------------------------------------------------
+
+    def scrubber(self):
+        """A Scrubber over this node's full storage plane (startup pass +
+        the ``unsafe_scrub`` RPC route; docs/DURABILITY.md)."""
+        from tendermint_tpu.store.scrub import Scrubber
+
+        idx_db = (self.tx_indexer._db
+                  if getattr(self, "tx_indexer", None) is not None
+                  and hasattr(self.tx_indexer, "_db") else None)
+        return Scrubber(
+            block_store=self.block_store, state_store=self.state_store,
+            evidence_db=self.evidence_pool._db, txindex_db=idx_db,
+            tracer=self.tracer)
 
     def p2p_addr(self) -> str:
         la = self.transport.node_info.listen_addr

@@ -827,6 +827,33 @@ def unsafe_nemesis(env, partition=None, heal=False, links=None):
     return nemesis.PLANE.describe()
 
 
+def unsafe_scrub(env, repair=True, timeout=10.0):
+    """On-demand storage-integrity scrub (store/scrub.py,
+    docs/DURABILITY.md; no reference analogue — the self-healing storage
+    plane's operator window).
+
+    Walks the block/state/evidence/tx-index stores, verifies every
+    record's CRC envelope + decode, quarantines anything rotten, and —
+    with ``repair`` (default true) — synchronously drains the repair
+    queue: blocks re-fetched from peers and batch-verified before rewrite,
+    state rebuilt from the block store, index rows re-derived. With
+    ``repair=false`` every finding is still SCHEDULED (quarantine deletes
+    the live row, so dropping the repair would orphan it permanently) but
+    drains on the repairer's background worker instead of blocking the
+    call. Returns the damage map plus what was healed."""
+    _require_unsafe(env)
+    repairer = getattr(env.node, "store_repairer", None)
+    do_repair = repair in (True, "true", "1", 1)
+    report = env.node.scrubber().scrub(
+        repairer=repairer, drain=do_repair,
+        repair_timeout_s=float(timeout))
+    out = report.as_dict()
+    if repairer is not None:
+        out["pending_repairs"] = [f"{k}:{a!r}" for k, a in repairer.pending()]
+        out["needs_statesync"] = repairer.needs_statesync
+    return out
+
+
 def unsafe_trace(env, enable=None, clear=False, dump=False):
     """Flight-recorder control + summary view (utils/trace.py,
     docs/OBSERVABILITY.md; no reference analogue — the reference exposes
@@ -904,6 +931,7 @@ ROUTES = {
     "unsafe_flush_mempool": unsafe_flush_mempool,
     "unsafe_nemesis": unsafe_nemesis,
     "unsafe_peers": unsafe_peers,
+    "unsafe_scrub": unsafe_scrub,
     "unsafe_trace": unsafe_trace,
     "unsafe_timeline": unsafe_timeline,
 }

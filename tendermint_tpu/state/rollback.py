@@ -62,7 +62,10 @@ def rollback(block_store: BlockStore, state_store: StateStore) -> tuple[int, byt
         last_validators=prev_validators,
         consensus_params=params,
         app_hash=latest_block.header.app_hash,
-        last_results_hash=rolled_back_block.header.last_results_hash,
+        # results(rollback_height) are committed by the NEXT header — the
+        # latest block — not the rolled-back header (rollback.go does the
+        # same: LastResultsHash comes from latestBlock)
+        last_results_hash=latest_block.header.last_results_hash,
     )
     state_store.save(rolled)
     return rolled.last_block_height, rolled.app_hash

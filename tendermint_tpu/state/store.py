@@ -17,6 +17,7 @@ from __future__ import annotations
 from tendermint_tpu.abci.types import ResponseDeliverTx
 from tendermint_tpu.encoding import proto
 from tendermint_tpu.state.state import State
+from tendermint_tpu.store import envelope
 from tendermint_tpu.store.db import DB
 from tendermint_tpu.types.block import Consensus
 from tendermint_tpu.types.block_id import BlockID
@@ -112,17 +113,35 @@ class ABCIResponses:
         )
 
 
+LOAD_SITE = "store.state.load"
+
+
 class StateStore:
     def __init__(self, db: DB):
         self._db = db
+        # repair hook: wired by the node to its StoreRepairer so every
+        # integrity detection quarantines + schedules (docs/DURABILITY.md)
+        self.on_corruption = None
+
+    def _load_checked(self, key: bytes, fn):
+        """DB get -> fault site -> envelope unwrap -> guarded decode: the
+        checked read path every load below routes through. Corruption
+        raises the typed CorruptedStoreError naming the key, never a bare
+        proto/struct error."""
+        raw = faults.mutate_value(LOAD_SITE, self._db.get(key))
+        if raw is None:
+            return None
+        return envelope.decode(raw, "state", key, fn,
+                               on_corruption=self.on_corruption)
+
+    def _set(self, key: bytes, payload: bytes) -> None:
+        self._db.set(key, envelope.wrap(payload))
 
     # --- state -------------------------------------------------------------
 
     def load(self) -> State:
-        raw = self._db.get(_STATE_KEY)
-        if raw is None:
-            return State()
-        return _unmarshal_state(raw)
+        st = self._load_checked(_STATE_KEY, _unmarshal_state)
+        return State() if st is None else st
 
     def save(self, state: State) -> None:
         """Persist state + index validator/params history (reference:
@@ -139,7 +158,7 @@ class StateStore:
         # crash between the history rows above and the state key below is
         # the interesting torn-state case replay must absorb
         faults.fire("store.state.save")
-        self._db.set(_STATE_KEY, _marshal_state(state))
+        self._set(_STATE_KEY, _marshal_state(state))
 
     def bootstrap(self, state: State) -> None:
         """reference: state/store.go:207-241."""
@@ -152,7 +171,7 @@ class StateStore:
         self._save_validators(height + 1, height + 1, state.next_validators)
         self._save_params(height, state.last_height_consensus_params_changed,
                           state.consensus_params)
-        self._db.set(_STATE_KEY, _marshal_state(state))
+        self._set(_STATE_KEY, _marshal_state(state))
 
     # --- validator history -------------------------------------------------
 
@@ -163,26 +182,52 @@ class StateStore:
             body = proto.Writer().message(1, vals.marshal(), always=True).varint(2, last_changed).out()
         else:
             body = proto.Writer().varint(2, last_changed).out()
-        self._db.set(_val_key(height), body)
+        self._set(_val_key(height), body)
 
     def load_validators(self, height: int) -> ValidatorSet:
         """reference: state/store.go:483-530 (with back-pointer chase)."""
-        raw = self._db.get(_val_key(height))
-        if raw is None:
+        f = self._load_checked(_val_key(height), proto.fields)
+        if f is None:
             raise ErrNoValSetForHeight(height)
-        f = proto.fields(raw)
         if 1 in f:
             return ValidatorSet.unmarshal(f[1][-1])
         last_changed = proto.as_sint64(f.get(2, [0])[-1])
-        raw2 = self._db.get(_val_key(last_changed))
-        if raw2 is None:
+        f2 = self._load_checked(_val_key(last_changed), proto.fields)
+        if f2 is None:
             raise ErrNoValSetForHeight(height)
-        f2 = proto.fields(raw2)
         if 1 not in f2:
             raise StateStoreError(
                 f"validator checkpoint at height {last_changed} is itself a pointer"
             )
         return ValidatorSet.unmarshal(f2[1][-1])
+
+    def validators_last_changed(self, height: int) -> int | None:
+        """The back-pointer (or self height) of one validator-history row;
+        None when the row is missing. The state repairer uses intact
+        NEIGHBOR rows to re-derive a quarantined pointer row
+        (store/repair.py)."""
+        f = self._load_checked(_val_key(height), proto.fields)
+        if f is None:
+            return None
+        return height if 1 in f else proto.as_sint64(f.get(2, [0])[-1])
+
+    def rewrite_validators(self, height: int, last_changed: int,
+                           vals: ValidatorSet | None) -> None:
+        """Repair-path write: re-lay one validator-history row (a FULL row
+        when ``vals`` is given, else a back-pointer to ``last_changed``)."""
+        if vals is not None:
+            self._save_validators(height, height, vals)
+        else:
+            self._set(_val_key(height),
+                      proto.Writer().varint(2, last_changed).out())
+
+    def params_last_changed(self, height: int) -> int | None:
+        """Pointer twin of :meth:`validators_last_changed` for the
+        consensus-params history (used by the state repairer)."""
+        f = self._load_checked(_params_key(height), proto.fields)
+        if f is None:
+            return None
+        return height if 1 in f else proto.as_sint64(f.get(2, [0])[-1])
 
     # --- consensus params history ------------------------------------------
 
@@ -191,32 +236,30 @@ class StateStore:
             body = proto.Writer().message(1, params.marshal(), always=True).varint(2, last_changed).out()
         else:
             body = proto.Writer().varint(2, last_changed).out()
-        self._db.set(_params_key(height), body)
+        self._set(_params_key(height), body)
 
     def load_consensus_params(self, height: int) -> ConsensusParams:
-        raw = self._db.get(_params_key(height))
-        if raw is None:
+        f = self._load_checked(_params_key(height), proto.fields)
+        if f is None:
             raise StateStoreError(f"could not find consensus params for height #{height}")
-        f = proto.fields(raw)
         if 1 in f:
             return ConsensusParams.unmarshal(f[1][-1])
         last_changed = proto.as_sint64(f.get(2, [0])[-1])
-        raw2 = self._db.get(_params_key(last_changed))
-        if raw2 is None:
+        f2 = self._load_checked(_params_key(last_changed), proto.fields)
+        if f2 is None:
             raise StateStoreError(f"could not find consensus params for height #{height}")
-        f2 = proto.fields(raw2)
         return ConsensusParams.unmarshal(f2[1][-1])
 
     # --- ABCI responses ----------------------------------------------------
 
     def save_abci_responses(self, height: int, responses: ABCIResponses) -> None:
-        self._db.set(_abci_key(height), responses.marshal())
+        self._set(_abci_key(height), responses.marshal())
 
     def load_abci_responses(self, height: int) -> ABCIResponses:
-        raw = self._db.get(_abci_key(height))
-        if raw is None:
+        resp = self._load_checked(_abci_key(height), ABCIResponses.unmarshal)
+        if resp is None:
             raise StateStoreError(f"could not find ABCI responses for height #{height}")
-        return ABCIResponses.unmarshal(raw)
+        return resp
 
     # --- pruning -----------------------------------------------------------
 

@@ -21,7 +21,9 @@ import base64
 import json
 import threading
 
+from tendermint_tpu.store import envelope
 from tendermint_tpu.store.db import DB, prefix_end
+from tendermint_tpu.utils import faults
 from tendermint_tpu.types import events as tmevents
 from tendermint_tpu.types.tx import tx_hash
 
@@ -30,12 +32,51 @@ def _esc(s: str) -> str:
     return s.replace("/", "%2F")
 
 
+LOAD_SITE = "store.txindex.load"
+
+
+def _checked(db, key: bytes, raw: bytes | None, fn, on_corruption=None):
+    """The indexers' checked read path: fault site -> envelope -> guarded
+    decode, quarantining on detection. Most index rows are DERIVED data the
+    repairer re-creates from the block + ABCI-responses stores (txr/, txe/,
+    blkh/); the repaired counter is bumped there, when the reindex actually
+    lands — never here at detection time (docs/DURABILITY.md)."""
+    raw = faults.mutate_value(LOAD_SITE, raw)
+    if raw is None:
+        return None
+    try:
+        return envelope.decode(raw, "txindex", key, fn,
+                               on_corruption=on_corruption)
+    except envelope.CorruptedStoreError:
+        envelope.quarantine(db, envelope.CorruptedStoreError(
+            "txindex", key, "quarantined on read", raw))
+        raise
+
+
+def _posting_hash(b: bytes) -> bytes:
+    """Strict posting decode: the value IS a 32-byte tx hash. Shape
+    validation closes the one envelope blind spot — a bit flip landing in
+    the 2-byte magic demotes the row to the legacy path, where an
+    identity decode would accept anything (docs/DURABILITY.md)."""
+    if len(b) != 32:
+        raise ValueError(f"posting value is {len(b)} bytes, want a 32-byte "
+                         "tx hash")
+    return b
+
+
+def _height_str(b: bytes) -> int:
+    """Strict decimal decode for blk/blkh height rows (same blind-spot
+    closure as _posting_hash)."""
+    return envelope.decimal_height(b)
+
+
 class TxIndexer:
     """reference: state/txindex/kv/kv.go:32 TxIndex."""
 
     def __init__(self, db: DB):
         self._db = db
         self._mtx = threading.Lock()
+        self.on_corruption = None
 
     def index(self, height: int, idx: int, tx: bytes, result) -> None:
         h = tx_hash(tx)
@@ -60,7 +101,7 @@ class TxIndexer:
                 ],
             },
         }
-        sets = [(b"txr/" + h, json.dumps(doc).encode())]
+        sets = [(b"txr/" + h, envelope.wrap(json.dumps(doc).encode()))]
         postings = [("tx.height", str(height))]
         for e in (result.events if result else []):
             for a in e.attributes:
@@ -72,13 +113,14 @@ class TxIndexer:
                     continue
         for key, value in postings:
             pk = f"txe/{_esc(key)}/{_esc(value)}/{height}/{idx}".encode()
-            sets.append((pk, h))
+            sets.append((pk, envelope.wrap(h)))
         with self._mtx:
             self._db.write_batch(sets)
 
     def get(self, h: bytes) -> dict | None:
-        raw = self._db.get(b"txr/" + h)
-        return json.loads(raw) if raw is not None else None
+        key = b"txr/" + h
+        return _checked(self._db, key, self._db.get(key), json.loads,
+                        on_corruption=self.on_corruption)
 
     def _scan(self, key: str, op: str, value: str | None) -> set[bytes]:
         """Candidate tx hashes for one condition (reference: kv.go:133
@@ -87,14 +129,28 @@ class TxIndexer:
         the posted values."""
         if op == "=":
             prefix = f"txe/{_esc(key)}/{_esc(value)}/".encode()
-            return {v for _, v in self._db.iterator(prefix, prefix_end(prefix))}
+            return {h for h in
+                    (self._posting(k, v) for k, v in
+                     list(self._db.iterator(prefix, prefix_end(prefix))))
+                    if h is not None}
         prefix = f"txe/{_esc(key)}/".encode()
         found = set()
-        for k, h in self._db.iterator(prefix, prefix_end(prefix)):
+        for k, v in list(self._db.iterator(prefix, prefix_end(prefix))):
             posted = k.decode().split("/")[2].replace("%2F", "/")
             if op == "exists" or tmevents.Query._cmp(op, posted, value):
-                found.add(h)
+                h = self._posting(k, v)
+                if h is not None:
+                    found.add(h)
         return found
+
+    def _posting(self, k: bytes, v: bytes) -> bytes | None:
+        """One posting row through the checked path; a corrupt posting is
+        quarantined and simply drops out of the candidate set."""
+        try:
+            return _checked(self._db, k, v, _posting_hash,
+                            on_corruption=self.on_corruption)
+        except envelope.CorruptedStoreError:
+            return None
 
     def search(self, query: str) -> list[dict]:
         """AND of conditions over the event postings; supports the full
@@ -110,7 +166,12 @@ class TxIndexer:
             result_hashes = found if result_hashes is None else (result_hashes & found)
             if not result_hashes:
                 return []
-        docs = [self.get(h) for h in result_hashes]
+        docs = []
+        for h in result_hashes:
+            try:
+                docs.append(self.get(h))
+            except envelope.CorruptedStoreError:
+                continue  # quarantined; the posting's doc is gone
         docs = [d for d in docs if d is not None]
         docs.sort(key=lambda d: (int(d["height"]), d["index"]))
         return docs
@@ -122,9 +183,11 @@ class BlockIndexer:
     def __init__(self, db: DB):
         self._db = db
         self._mtx = threading.Lock()
+        self.on_corruption = None
 
     def index(self, height: int, begin_block_events, end_block_events) -> None:
-        sets = [(f"blkh/{height}".encode(), str(height).encode())]
+        sets = [(f"blkh/{height}".encode(),
+                 envelope.wrap(str(height).encode()))]
         for stage, evs in (("begin_block", begin_block_events),
                            ("end_block", end_block_events)):
             for e in evs or []:
@@ -137,12 +200,19 @@ class BlockIndexer:
                     except UnicodeDecodeError:
                         continue
                     pk = f"blk/{_esc(key)}/{_esc(value)}/{height}".encode()
-                    sets.append((pk, str(height).encode()))
+                    sets.append((pk, envelope.wrap(str(height).encode())))
         with self._mtx:
             self._db.write_batch(sets)
 
     def has(self, height: int) -> bool:
         return self._db.get(f"blkh/{height}".encode()) is not None
+
+    def _height_row(self, k: bytes, v: bytes) -> int | None:
+        try:
+            return _checked(self._db, k, v, _height_str,
+                            on_corruption=self.on_corruption)
+        except envelope.CorruptedStoreError:
+            return None
 
     def search(self, query: str) -> list[int]:
         q = tmevents.Query(query)
@@ -157,21 +227,28 @@ class BlockIndexer:
                     found = {int(value)} if self.has(int(value)) else set()
                 else:
                     prefix = b"blkh/"
-                    found = {
-                        int(v) for _, v in
-                        self._db.iterator(prefix, prefix_end(prefix))
-                        if op == "exists"
-                        or tmevents.Query._cmp(op, v.decode(), value)}
+                    found = set()
+                    for k, v in list(self._db.iterator(prefix, prefix_end(prefix))):
+                        h = self._height_row(k, v)
+                        if h is not None and (
+                                op == "exists"
+                                or tmevents.Query._cmp(op, str(h), value)):
+                            found.add(h)
             elif op == "=":
                 prefix = f"blk/{_esc(key)}/{_esc(value)}/".encode()
-                found = {int(v) for _, v in self._db.iterator(prefix, prefix_end(prefix))}
+                found = {h for h in
+                         (self._height_row(k, v) for k, v in
+                          list(self._db.iterator(prefix, prefix_end(prefix))))
+                         if h is not None}
             else:
                 prefix = f"blk/{_esc(key)}/".encode()
                 found = set()
-                for k, v in self._db.iterator(prefix, prefix_end(prefix)):
+                for k, v in list(self._db.iterator(prefix, prefix_end(prefix))):
                     posted = k.decode().split("/")[2].replace("%2F", "/")
                     if op == "exists" or tmevents.Query._cmp(op, posted, value):
-                        found.add(int(v))
+                        h = self._height_row(k, v)
+                        if h is not None:
+                            found.add(h)
             heights = found if heights is None else (heights & found)
             if not heights:
                 return []

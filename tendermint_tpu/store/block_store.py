@@ -8,6 +8,13 @@ Layout (one KV row per item, like the reference's calc*Key scheme):
   SC:<height>       -> Commit proto   (locally seen commit for height)
   BH:<hash>         -> height (decimal)
   blockStore        -> BlockStoreState {base, height}
+
+Every value is written inside the CRC32 integrity envelope
+(store/envelope.py) and every read routes through the checked decode: a
+flipped bit raises a typed CorruptedStoreError naming the key (and fires
+the ``on_corruption`` repair hook) instead of an unhandled proto error or
+a silently-served bad block. Pre-envelope rows read compatibly
+(docs/DURABILITY.md).
 """
 
 from __future__ import annotations
@@ -16,7 +23,8 @@ import threading
 from dataclasses import dataclass, field as dc_field
 
 from tendermint_tpu.encoding import proto
-from tendermint_tpu.store.db import DB
+from tendermint_tpu.store import envelope
+from tendermint_tpu.store.db import DB, prefix_end
 from tendermint_tpu.utils import faults
 from tendermint_tpu.types.block import Block, Commit, Header
 from tendermint_tpu.types.block_id import BlockID
@@ -76,20 +84,77 @@ def _hash_key(block_hash: bytes) -> bytes:
 _STATE_KEY = b"blockStore"
 
 
+def _block_rows(block: Block, part_set: PartSet) -> list:
+    """The meta / BH / part / last-commit rows every block writer lays
+    down. save_block and the repair path's rewrite_block share this so a
+    repaired height is byte-identical to a freshly saved one — any layout
+    change lands in both writers at once."""
+    height = block.header.height
+    block_id = BlockID(hash=block.hash(), part_set_header=part_set.header())
+    meta = BlockMeta(
+        block_id=block_id,
+        block_size=sum(len(p.bytes_) for p in part_set.parts),
+        header=block.header,
+        num_txs=len(block.data.txs),
+    )
+    sets = [(_meta_key(height), envelope.wrap(meta.marshal())),
+            (_hash_key(block.hash()), envelope.wrap(str(height).encode()))]
+    for i, part in enumerate(part_set.parts):
+        sets.append((_part_key(height, i), envelope.wrap(part.marshal())))
+    if block.last_commit is not None:
+        sets.append((_commit_key(height - 1),
+                     envelope.wrap(block.last_commit.marshal())))
+    return sets
+
+LOAD_SITE = "store.block.load"
+
+
 class BlockStore:
     """Thread-safe; mirrors store/store.go semantics including pruning."""
 
     def __init__(self, db: DB):
         self._db = db
         self._mtx = threading.RLock()
+        # repair hook: the node wires this to its StoreRepairer so every
+        # detection quarantines + schedules without the caller's help
+        self.on_corruption = None
         st = db.get(_STATE_KEY)
         if st is None:
             self.base = 0
             self.height = 0
         else:
-            f = proto.fields(st)
-            self.base = proto.as_sint64(f.get(1, [0])[-1])
-            self.height = proto.as_sint64(f.get(2, [0])[-1])
+            try:
+                f = self._decode(_STATE_KEY, st, proto.fields)
+                self.base = proto.as_sint64(f.get(1, [0])[-1])
+                self.height = proto.as_sint64(f.get(2, [0])[-1])
+            except envelope.CorruptedStoreError:
+                # the {base, height} row is fully re-derivable from the H:
+                # keyspace: self-heal instead of refusing to construct
+                self.base, self.height = self._rederive_state()
+                envelope.quarantine(db, envelope.CorruptedStoreError(
+                    "block", _STATE_KEY, "rederived after corruption", st))
+                db.set(_STATE_KEY, envelope.wrap(self._state_bytes()))
+                envelope.count_repair("block")
+
+    def _rederive_state(self) -> tuple[int, int]:
+        lo = next(self._db.iterator(b"H:", prefix_end(b"H:")), None)
+        hi = next(self._db.reverse_iterator(b"H:", prefix_end(b"H:")), None)
+        if lo is None or hi is None:
+            return 0, 0
+        return int(lo[0][2:]), int(hi[0][2:])
+
+    # --- the checked read path --------------------------------------------
+
+    def _load(self, key: bytes, fn):
+        """DB get -> fault site -> envelope unwrap -> guarded decode."""
+        raw = faults.mutate_value(LOAD_SITE, self._db.get(key))
+        if raw is None:
+            return None
+        return self._decode(key, raw, fn)
+
+    def _decode(self, key: bytes, raw: bytes, fn):
+        return envelope.decode(raw, "block", key, fn,
+                               on_corruption=self.on_corruption)
 
     # --- accessors ---------------------------------------------------------
 
@@ -99,11 +164,11 @@ class BlockStore:
 
     def load_base_meta(self) -> BlockMeta | None:
         with self._mtx:
-            return self.load_block_meta(self.base) if self.base else None
+            base = self.base
+        return self.load_block_meta(base) if base else None
 
     def load_block_meta(self, height: int) -> BlockMeta | None:
-        raw = self._db.get(_meta_key(height))
-        return BlockMeta.unmarshal(raw) if raw is not None else None
+        return self._load(_meta_key(height), BlockMeta.unmarshal)
 
     def load_block(self, height: int) -> Block | None:
         meta = self.load_block_meta(height)
@@ -111,31 +176,31 @@ class BlockStore:
             return None
         parts = []
         for i in range(meta.block_id.part_set_header.total):
-            raw = self._db.get(_part_key(height, i))
-            if raw is None:
+            part = self._load(_part_key(height, i), Part.unmarshal)
+            if part is None:
                 return None
-            parts.append(Part.unmarshal(raw).bytes_)
-        return Block.unmarshal(b"".join(parts))
+            parts.append(part.bytes_)
+        # the joined payload is unframed; the guarded decode still converts
+        # any unmarshal blow-up into the typed error naming the height
+        return self._decode(_meta_key(height), b"".join(parts),
+                            Block.unmarshal)
 
     def load_block_by_hash(self, block_hash: bytes) -> Block | None:
-        raw = self._db.get(_hash_key(block_hash))
-        if raw is None:
+        h = self._load(_hash_key(block_hash), envelope.decimal_height)
+        if h is None:
             return None
-        return self.load_block(int(raw.decode()))
+        return self.load_block(h)
 
     def load_block_part(self, height: int, index: int) -> Part | None:
-        raw = self._db.get(_part_key(height, index))
-        return Part.unmarshal(raw) if raw is not None else None
+        return self._load(_part_key(height, index), Part.unmarshal)
 
     def load_block_commit(self, height: int) -> Commit | None:
         """Commit for `height` stored with block height+1 (reference:
         store/store.go:203)."""
-        raw = self._db.get(_commit_key(height))
-        return Commit.unmarshal(raw) if raw is not None else None
+        return self._load(_commit_key(height), Commit.unmarshal)
 
     def load_seen_commit(self, height: int) -> Commit | None:
-        raw = self._db.get(_seen_commit_key(height))
-        return Commit.unmarshal(raw) if raw is not None else None
+        return self._load(_seen_commit_key(height), Commit.unmarshal)
 
     # --- mutation ----------------------------------------------------------
 
@@ -151,25 +216,14 @@ class BlockStore:
             if not part_set.is_complete():
                 raise ValueError("BlockStore can only save complete block part sets")
 
-            block_id = BlockID(hash=block.hash(), part_set_header=part_set.header())
-            meta = BlockMeta(
-                block_id=block_id,
-                block_size=sum(len(p.bytes_) for p in part_set.parts),
-                header=block.header,
-                num_txs=len(block.data.txs),
-            )
-            sets = [(_meta_key(height), meta.marshal()),
-                    (_hash_key(block.hash()), str(height).encode())]
-            for i, part in enumerate(part_set.parts):
-                sets.append((_part_key(height, i), part.marshal()))
-            if block.last_commit is not None:
-                sets.append((_commit_key(height - 1), block.last_commit.marshal()))
-            sets.append((_seen_commit_key(height), seen_commit.marshal()))
+            sets = _block_rows(block, part_set)
+            sets.append((_seen_commit_key(height),
+                         envelope.wrap(seen_commit.marshal())))
 
             self.height = height
             if self.base == 0:
                 self.base = height
-            sets.append((_STATE_KEY, self._state_bytes()))
+            sets.append((_STATE_KEY, envelope.wrap(self._state_bytes())))
             faults.fire("store.block.save")
             self._db.write_batch(sets)
 
@@ -177,7 +231,36 @@ class BlockStore:
         """Standalone seen-commit write for the state-sync bootstrap
         (reference: store/store.go:385 SaveSeenCommit)."""
         with self._mtx:
-            self._db.set(_seen_commit_key(height), seen_commit.marshal())
+            self._db.set(_seen_commit_key(height),
+                         envelope.wrap(seen_commit.marshal()))
+
+    def rewrite_block(self, block: Block, part_set: PartSet,
+                      commit: Commit | None) -> bool:
+        """Repair-path write: re-lay every row of an ALREADY-COMMITTED
+        height from a verified block (store/repair.py), without the
+        contiguity/state bookkeeping of save_block — base/height are
+        untouched, the damage was record-level. Returns False without
+        writing when the height left the live range while the repair was
+        in flight (a concurrent prune_blocks advanced ``base``): rows
+        re-laid below base would never be revisited by pruning and leak
+        forever."""
+        height = block.header.height
+        sets = _block_rows(block, part_set)
+        if commit is not None:
+            # fill only the commit rows the damage took: an intact C: row
+            # keeps its original bytes, a lost SC: row is restored from the
+            # canonical commit (a different-but-valid +2/3 sig set is fine)
+            if self._db.get(_commit_key(height)) is None:
+                sets.append((_commit_key(height),
+                             envelope.wrap(commit.marshal())))
+            if self._db.get(_seen_commit_key(height)) is None:
+                sets.append((_seen_commit_key(height),
+                             envelope.wrap(commit.marshal())))
+        with self._mtx:
+            if not (self.base <= height <= self.height):
+                return False  # pruned (or rolled back) mid-repair
+            self._db.write_batch(sets)
+        return True
 
     def prune_blocks(self, height: int) -> int:
         """Removes blocks below `height`, keeping `height` (reference:
@@ -191,8 +274,20 @@ class BlockStore:
                 return 0
             pruned = 0
             deletes: list[bytes] = []
+            bh_index = None  # built on first corrupt meta, shared by all
             for h in range(self.base, height):
-                meta = self.load_block_meta(h)
+                try:
+                    meta = self.load_block_meta(h)
+                except envelope.CorruptedStoreError:
+                    # a corrupt meta must not wedge pruning OR leak its
+                    # height's rows forever: fall back to prefix scans (one
+                    # BH: keyspace pass per prune call, not per height —
+                    # this all runs under the store mutex)
+                    if bh_index is None:
+                        bh_index = self._bh_rows_by_height()
+                    deletes.extend(self._keys_for_height_scan(h, bh_index))
+                    pruned += 1
+                    continue
                 if meta is None:
                     continue
                 deletes.append(_meta_key(h))
@@ -203,8 +298,33 @@ class BlockStore:
                     deletes.append(_part_key(h, i))
                 pruned += 1
             self.base = height
-            self._db.write_batch([(_STATE_KEY, self._state_bytes())], deletes)
+            self._db.write_batch([(_STATE_KEY, envelope.wrap(self._state_bytes()))],
+                                 deletes)
             return pruned
+
+    def _bh_rows_by_height(self) -> dict[bytes | None, list[bytes]]:
+        """One pass over the BH: keyspace: decimal height bytes -> [keys],
+        with undecodable rows collected under ``None``."""
+        out: dict[bytes | None, list[bytes]] = {}
+        for k, v in self._db.iterator(b"BH:", prefix_end(b"BH:")):
+            try:
+                out.setdefault(envelope.unwrap(v, "block", k), []).append(k)
+            except envelope.CorruptedStoreError:
+                out.setdefault(None, []).append(k)
+        return out
+
+    def _keys_for_height_scan(self, h: int, bh_index: dict) -> list[bytes]:
+        """All live rows of one height found by prefix scan (the
+        meta-corrupt pruning fallback: part count and block hash are not
+        decodable, so enumerate instead of computing). ``bh_index`` is the
+        shared :meth:`_bh_rows_by_height` map; undecodable BH rows are
+        pruned with the first corrupt height that consults it."""
+        keys = [_meta_key(h), _commit_key(h - 1), _seen_commit_key(h)]
+        pp = b"P:%020d:" % h
+        keys.extend(k for k, _ in self._db.iterator(pp, prefix_end(pp)))
+        keys.extend(bh_index.get(str(h).encode(), ()))
+        keys.extend(bh_index.pop(None, ()))
+        return keys
 
     def _state_bytes(self) -> bytes:
         return proto.Writer().varint(1, self.base).varint(2, self.height).out()

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import abc
 import bisect
+import os
 import sqlite3
 import threading
 from pathlib import Path
@@ -92,15 +93,27 @@ class MemDB(DB):
 
 
 class SQLiteDB(DB):
-    """Durable backend on stdlib sqlite3 (WAL mode, fsync on commit)."""
+    """Durable backend on stdlib sqlite3 (WAL mode, fsync on commit).
+
+    Durability policy is ``PRAGMA synchronous`` — ``NORMAL`` by default:
+    in WAL mode a commit is fsynced only at WAL checkpoints, so an OS
+    crash / power loss can roll the DB back to the last checkpoint
+    (application-level recovery — consensus WAL replay + fast-sync —
+    absorbs that window). ``TMTPU_DB_SYNC=full`` pins ``synchronous=FULL``
+    (every commit fsyncs the WAL: no power-loss window, slower writes).
+    See docs/CONFIG.md."""
 
     def __init__(self, path: str) -> None:
         Path(path).parent.mkdir(parents=True, exist_ok=True)
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.RLock()
+        sync = os.environ.get("TMTPU_DB_SYNC", "normal").strip().lower()
+        if sync not in ("normal", "full"):
+            raise ValueError(
+                f"TMTPU_DB_SYNC={sync!r} (want 'normal' or 'full')")
         with self._lock:
             self._conn.execute("PRAGMA journal_mode=WAL")
-            self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.execute(f"PRAGMA synchronous={sync.upper()}")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL)"
             )
@@ -164,6 +177,13 @@ class SQLiteDB(DB):
     def close(self) -> None:
         with self._lock:
             self._conn.commit()
+            try:
+                # fsync-on-close: fold the WAL back into the main DB file
+                # and sync it, so a clean shutdown leaves no replay window
+                # regardless of the synchronous level above
+                self._conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+            except sqlite3.Error:
+                pass  # a reader holding the WAL open only defers the fold
             self._conn.close()
 
 

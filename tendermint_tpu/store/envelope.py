@@ -1,0 +1,189 @@
+"""Checksummed record envelope for the storage plane (docs/DURABILITY.md).
+
+Outside the WAL (which has CRC32-framed records and torn-write repair since
+PR 1), the stores used to hand back raw DB bytes: a single flipped bit in a
+BlockStore part row was either an unhandled proto error inside a reactor
+thread or a silently-served bad block.  Every value the stores write is now
+framed as::
+
+    0xC5 0x01 <crc32-be, 4 bytes> <payload>
+
+and every read routes through :func:`decode`, which verifies the CRC and
+runs the record's unmarshal under a guard — any mismatch or decode blow-up
+raises a typed :class:`CorruptedStoreError` naming the store and key, never
+a bare struct/proto error.
+
+**Versioned, legacy-compatible.** A value that does not start with the
+two-byte magic is treated as a version-0 unframed row and handed to the
+decoder as-is, so stores written before the envelope existed keep reading
+(no migration step; the next write of the row frames it).  No legacy row in
+this tree ever starts with ``0xC5``: proto-encoded rows start with a field
+tag (``0x08``/``0x0A``...), BH rows with an ASCII digit, the evidence
+committed marker is ``0x01``.
+
+Corruption is *detected* here and *handled* above: the stores invoke their
+``on_corruption`` callback (wired to the node's StoreRepairer, which
+quarantines the record and schedules repair — store/repair.py) before the
+typed error propagates to the caller, so even a caller that only knows how
+to crash still leaves the plane self-healing.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+MAGIC = b"\xc5\x01"
+_HEADER_LEN = len(MAGIC) + 4
+
+# the closed store-label universe: metric labels, scrub report keys, and
+# CorruptedStoreError.store values all draw from this tuple
+STORES = ("block", "state", "evidence", "txindex")
+
+
+class CorruptedStoreError(Exception):
+    """A store record failed its integrity check (CRC mismatch, truncated
+    envelope, or an unmarshal blow-up on the payload). Carries the store
+    name, the exact DB key, and — when available — the raw bytes so the
+    repairer can quarantine a forensic copy."""
+
+    def __init__(self, store: str, key: bytes, reason: str,
+                 raw: bytes | None = None):
+        self.store = store
+        self.key = key
+        self.reason = reason
+        self.raw = raw
+        super().__init__(
+            f"corrupted {store}-store record at key {key!r}: {reason}")
+
+
+def _hamming2(b0: int, b1: int) -> int:
+    return ((b0 ^ MAGIC[0]).bit_count() + (b1 ^ MAGIC[1]).bit_count())
+
+
+def wrap(payload: bytes) -> bytes:
+    """Frame a value for storage: magic + version + CRC32 + payload."""
+    return MAGIC + zlib.crc32(payload).to_bytes(4, "big") + payload
+
+
+def is_framed(raw: bytes) -> bool:
+    return raw[:2] == MAGIC
+
+
+def unwrap(raw: bytes, store: str, key: bytes) -> bytes:
+    """Envelope -> payload. Unframed (pre-envelope) rows pass through
+    unchanged; a framed row with a bad CRC or a truncated header raises
+    :class:`CorruptedStoreError`. Empty rows are corrupt by construction —
+    no store writes one, and a truncation-to-nothing must not decode as a
+    defaults-filled record."""
+    if not raw:
+        raise CorruptedStoreError(store, key, "empty record", raw)
+    if raw[:2] != MAGIC:
+        # a SINGLE bit flip inside the two-byte magic would demote a framed
+        # row to the legacy path, where a lenient payload decode might
+        # accept the garbage — treat near-magic headers as damaged
+        # envelopes instead. (A genuine pre-envelope row starting within
+        # Hamming distance 1 of C5 01 is essentially impossible in this
+        # tree: proto rows start with a small field tag, BH/index rows with
+        # ASCII, docs with '{'.)
+        if len(raw) >= 2 and _hamming2(raw[0], raw[1]) <= 1:
+            raise CorruptedStoreError(
+                store, key, "bit-flipped envelope magic", raw)
+        return raw  # version-0 legacy row
+    if len(raw) < _HEADER_LEN:
+        raise CorruptedStoreError(store, key, "truncated envelope header", raw)
+    payload = raw[_HEADER_LEN:]
+    want = int.from_bytes(raw[2:_HEADER_LEN], "big")
+    got = zlib.crc32(payload)
+    if got != want:
+        raise CorruptedStoreError(
+            store, key, f"crc mismatch (stored {want:08x}, computed {got:08x})",
+            raw)
+    return payload
+
+
+def decimal_height(b: bytes) -> int:
+    """Strict ASCII-decimal decode for height-valued rows (BH:, blkh/ and
+    blk/ postings). Bare ``int(b.decode())`` accepts b" 2\\n" or b"1_0"
+    (Python allows whitespace and underscores), which would let a damaged
+    short row decode leniently on the legacy path."""
+    s = b.decode("ascii")
+    if not s.isdigit():
+        raise ValueError(f"height row is {b!r}, want ASCII decimal")
+    return int(s)
+
+
+def decode(raw: bytes, store: str, key: bytes, fn, on_corruption=None):
+    """The checked read path every store load routes through: unwrap the
+    envelope, then run ``fn(payload)`` under a guard so a bit flip that
+    survives into the payload of a LEGACY (unframed) row still surfaces as
+    the typed error, not a bare proto/struct exception.  ``on_corruption``
+    (the store's repairer hook) fires once per detection, and must never
+    itself raise into the read path."""
+    try:
+        payload = unwrap(raw, store, key)
+        return fn(payload)
+    except CorruptedStoreError as e:
+        _note(e, on_corruption)
+        raise
+    except Exception as e:  # noqa: BLE001 - any decode blow-up IS corruption
+        err = CorruptedStoreError(store, key, f"decode failed: {e!r}", raw)
+        _note(err, on_corruption)
+        raise err from e
+
+
+def _note(err: CorruptedStoreError, on_corruption) -> None:
+    count_detection(err.store)
+    if on_corruption is not None:
+        try:
+            on_corruption(err)
+        except Exception:  # noqa: BLE001 - the hook is best-effort; the
+            # typed error still propagates to the caller either way
+            pass
+
+
+def count_detection(store: str) -> None:
+    """Bump the pre-seeded `store_corruption_detected_total{store}` counter
+    (utils/metrics.py) when a node has metrics enabled."""
+    try:
+        from tendermint_tpu.utils import metrics as tmmetrics
+
+        m = tmmetrics.GLOBAL_NODE_METRICS
+        if m is not None:
+            m.store_corruption_detected.add(1, store=store)
+    except Exception:  # noqa: BLE001 - metrics must never block a read
+        pass
+
+
+def count_repair(store: str) -> None:
+    try:
+        from tendermint_tpu.utils import metrics as tmmetrics
+
+        m = tmmetrics.GLOBAL_NODE_METRICS
+        if m is not None:
+            m.store_corruption_repaired.add(1, store=store)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+# --- quarantine --------------------------------------------------------------
+
+QUARANTINE_PREFIX = b"Q:"
+
+
+def quarantine(db, err: CorruptedStoreError) -> None:
+    """Move the corrupt record out of the live keyspace: a forensic copy
+    lands under ``Q:<key>`` and the original is deleted, so every later
+    read sees *missing* (handled everywhere) instead of *corrupt* — the
+    store never serves the bad bytes twice."""
+    raw = err.raw if err.raw is not None else db.get(err.key)
+    if raw is not None:
+        db.set(QUARANTINE_PREFIX + err.key, raw)
+    db.delete(err.key)
+
+
+def quarantined_keys(db) -> list[bytes]:
+    """Original keys of every quarantined record (forensics / tests)."""
+    from tendermint_tpu.store.db import prefix_end
+
+    return [k[len(QUARANTINE_PREFIX):] for k, _ in
+            db.iterator(QUARANTINE_PREFIX, prefix_end(QUARANTINE_PREFIX))]

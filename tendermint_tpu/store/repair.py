@@ -1,0 +1,641 @@
+"""Peer-assisted storage repair: quarantine, re-fetch, re-verify, rewrite
+(docs/DURABILITY.md).
+
+The repairer is where a corruption detection (store/envelope.py) turns
+into healing instead of a crash:
+
+* **block rows** (meta / parts / commits / BH index): the block is
+  re-fetched from peers over the fast-sync wire protocol (BlockRequest on
+  channel 0x40 — the same machinery the pool uses), re-verified against
+  this node's OWN validator set and a trusted commit through
+  ``ValidatorSet.verify_commit_light`` (one batched kernel call), and only
+  then rewritten. A peer can never talk a node into accepting different
+  bytes: the commit signatures pin the block hash.
+* **state rows**: the full state row is rebuilt from the block store
+  (rollback-style reconstruction at tip-1; the startup handshake replays
+  the final block through the app — "replay-from-blockstore"). When the
+  block store cannot support the rebuild the verdict is
+  ``needs_statesync`` and the node's normal state-sync bootstrap path
+  takes over. Unambiguously re-derivable history rows are rewritten;
+  anything else stays quarantined (reads see *missing*, never rot).
+* **evidence rows**: for pending evidence, quarantine IS repair — it
+  regossips from peers. The committed ``c:<hash>`` marker is rewritten in
+  place: its value is a constant and ``is_committed`` only tests key
+  presence, so leaving it quarantined would re-open a double-commit
+  window for that evidence.
+* **tx-index rows**: tx documents and event postings (``txr/``, ``txe/``,
+  ``blkh/``) are re-indexed from the block + ABCI-responses stores when
+  both are wired. Block-event postings (``blk/``) are NOT re-derivable —
+  ABCIResponses persists only the DeliverTx results, so begin/end-block
+  events exist nowhere else — and stay quarantined.
+
+Detection sites call :meth:`StoreRepairer.note` (the stores'
+``on_corruption`` hook): it quarantines immediately — the record can never
+be served twice — and schedules the repair on a lazy background worker
+(spawned on first damage, so an undamaged node pays zero threads).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tendermint_tpu.store import envelope
+from tendermint_tpu.store import block_store as bs_mod
+from tendermint_tpu.utils import trace as _trace
+
+FETCH_TIMEOUT_S = 3.0
+MAX_ATTEMPTS = 8
+
+
+def _task_key(store: str, key: bytes) -> tuple:
+    """(kind, arg) repair task for one corrupt record's key."""
+    if store == "block":
+        if key.startswith((b"H:", b"P:", b"SC:")):
+            return ("block", int(key.split(b":")[1]))
+        if key.startswith(b"C:"):
+            return ("block", int(key.split(b":")[1]))
+        if key.startswith(b"BH:"):
+            return ("block_hash_row", key[3:])
+        return ("noop", key)  # blockStore row self-heals in the constructor
+    if store == "state":
+        if key == b"stateKey":
+            return ("state", None)
+        if key.startswith(b"validatorsKey:"):
+            return ("state_val", int(key.rsplit(b":", 1)[-1]))
+        if key.startswith(b"consensusParamsKey:"):
+            return ("state_params", int(key.rsplit(b":", 1)[-1]))
+        return ("state_abci", key)  # not re-derivable: quarantine only
+    if store == "evidence":
+        if key.startswith(b"c"):
+            # presence-only marker: restore it or the quarantine itself
+            # re-opens a double-commit window (is_committed -> False)
+            return ("evidence_marker", key)
+        return ("noop", key)  # pending: drop IS repair (regossip)
+    if store == "txindex":
+        parts = key.split(b"/")
+        if key.startswith(b"txr/"):
+            # the doc key carries no height, but the surviving tx.height
+            # posting's VALUE is this hash — the repair scans for it
+            return ("txindex_doc", key[4:])
+        if key.startswith(b"txe/") and len(parts) >= 5:
+            return ("txindex", int(parts[3]))
+        if key.startswith(b"blkh/") and len(parts) >= 2:
+            try:
+                return ("txindex", int(parts[-1]))
+            except ValueError:
+                return ("txindex_row", key)
+        if key.startswith(b"blk/"):
+            # block-event postings aren't persisted anywhere else (the
+            # ABCI-responses row carries only DeliverTx results): not
+            # re-derivable, quarantine is final
+            return ("txindex_row", key)
+        return ("txindex_row", key)  # doc row: height unknowable, drop
+    return ("noop", key)
+
+
+class StoreRepairer:
+    """Owns quarantine + the repair queue for one node's storage plane."""
+
+    def __init__(self, block_store=None, state_store=None, chain_id: str = "",
+                 evidence_db=None, tx_indexer=None, block_indexer=None,
+                 logger=None, tracer=None):
+        self.block_store = block_store
+        self.state_store = state_store
+        self.chain_id = chain_id
+        self.evidence_db = evidence_db
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self.switch = None          # wired by the node once p2p exists
+        self.logger = logger
+        self.tracer = tracer
+        self.needs_statesync = False
+        self.repaired_total = 0
+        self._lock = threading.Lock()
+        self._pending: dict[tuple, int] = {}   # task -> attempts
+        self._failed: list[str] = []
+        self._waiters: dict[int, list] = {}    # height -> [(Event, [Block])]
+        self._worker: threading.Thread | None = None
+        self._wake = threading.Event()
+
+    # --- detection entry (the stores' on_corruption hook) -------------------
+
+    def note(self, err: envelope.CorruptedStoreError,
+             spawn: bool = True) -> None:
+        """Quarantine the record and schedule its repair. Idempotent and
+        non-blocking: safe to fire from any read path. ``spawn=False``
+        queues without waking the background worker (the scrubber drains
+        synchronously right after scheduling)."""
+        db = self._db_for(err.store)
+        if db is not None:
+            try:
+                envelope.quarantine(db, err)
+            except Exception:  # noqa: BLE001 - quarantine is best-effort;
+                # the read already failed typed, scheduling still happens
+                pass
+        task = _task_key(err.store, err.key)
+        if task[0] == "noop":
+            return
+        if self.logger is not None:
+            self.logger.error("store corruption quarantined", store=err.store,
+                              key=repr(err.key), reason=err.reason)
+        with self._lock:
+            self._pending.setdefault(task, 0)
+            if spawn:
+                self._ensure_worker_locked()
+        if spawn:
+            self._wake.set()
+
+    def _db_for(self, store: str):
+        if store == "block" and self.block_store is not None:
+            return self.block_store._db
+        if store == "state" and self.state_store is not None:
+            return self.state_store._db
+        if store == "evidence":
+            return self.evidence_db
+        if store == "txindex" and self.tx_indexer is not None:
+            return self.tx_indexer._db
+        return None
+
+    # --- background worker (lazy: zero threads until first damage) ----------
+
+    def _ensure_worker_locked(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(target=self._worker_loop,
+                                            name="store-repair", daemon=True)
+            self._worker.start()
+
+    def _worker_loop(self) -> None:
+        backoff = 0.2
+        while True:
+            try:
+                self._wake.wait(timeout=backoff)
+                self._wake.clear()
+                done, _failed = self.repair_pending(timeout_s=FETCH_TIMEOUT_S)
+                with self._lock:
+                    if not self._pending:
+                        self._worker = None
+                        return
+                backoff = 0.2 if done else min(backoff * 2, 5.0)
+            except Exception as e:  # noqa: BLE001 - the repair loop must
+                # survive anything (peer churn, store races); retry later
+                if self.logger is not None:
+                    self.logger.error("store repair pass failed", err=e)
+                time.sleep(0.5)
+
+    # --- synchronous drain (scrubber, unsafe_scrub RPC, tests) --------------
+
+    def pending(self) -> list[tuple]:
+        with self._lock:
+            return sorted(self._pending)
+
+    def repair_pending(self, timeout_s: float = 10.0) -> tuple[list, list]:
+        """Attempt every scheduled repair once (peer fetches bounded by
+        ``timeout_s`` each). Returns (repaired descriptions, failed-this-
+        pass descriptions); failures stay queued until MAX_ATTEMPTS. An
+        attempt may return ``None`` — "can't try yet" (p2p is wired but no
+        peer is connected, the boot-scrub window) — which keeps the task
+        queued WITHOUT burning an attempt, so a corruption detected before
+        the first peer handshake still heals once peers arrive instead of
+        exhausting its budget against an empty switch."""
+        with self._lock:
+            tasks = sorted(self._pending)
+        done: list[str] = []
+        failed: list[str] = []
+        for task in tasks:
+            kind, arg = task
+            label = f"{kind}:{arg!r}"
+            try:
+                ok = self._attempt(kind, arg, timeout_s)
+            except Exception as e:  # noqa: BLE001 - one broken repair must
+                # not abandon the rest of the queue
+                ok = False
+                label = f"{label} ({e!r})"
+            with self._lock:
+                if ok:
+                    self._pending.pop(task, None)
+                    done.append(label)
+                elif ok is None:  # no peers yet: retry later, free of charge
+                    failed.append(label)
+                else:
+                    self._pending[task] = self._pending.get(task, 0) + 1
+                    if self._pending[task] >= MAX_ATTEMPTS:
+                        self._pending.pop(task, None)
+                        self._failed.append(label)
+                    failed.append(label)
+        return done, failed
+
+    def _attempt(self, kind: str, arg, timeout_s: float) -> bool:
+        if kind == "block":
+            return self.repair_block_height(int(arg), timeout_s=timeout_s)
+        if kind == "block_hash_row":
+            return self._repair_block_hash_row(arg)
+        if kind == "state":
+            return self.repair_state()
+        if kind == "state_val":
+            return self._repair_validators_row(int(arg))
+        if kind == "state_params":
+            return self._repair_params_row(int(arg))
+        if kind == "state_abci":
+            return True  # not re-derivable; quarantined = handled
+        if kind == "evidence_marker":
+            return self._restore_committed_marker(arg)
+        if kind == "txindex":
+            return self._reindex_height(int(arg))
+        if kind == "txindex_doc":
+            return self._reindex_doc(arg)
+        if kind == "txindex_row":
+            return True  # blk/ posting quarantined; not re-derivable
+        return True
+
+    def _repaired(self, store: str) -> bool:
+        self.repaired_total += 1
+        envelope.count_repair(store)
+        return True
+
+    # --- block repair: re-fetch from peers, batch-verify, rewrite -----------
+
+    def repair_block_height(self, height: int,
+                            timeout_s: float = FETCH_TIMEOUT_S):
+        """Restore every row of one damaged height. The rewritten block is
+        ALWAYS re-verified before it touches the store: its hash must be
+        signed by +2/3 of this node's own validator set at that height
+        (``verify_commit_light`` — the batched kernel path), and must match
+        the intact local meta/commit when one survives. Returns True on
+        repaired/nothing-to-heal, False on a failed (counted) attempt, and
+        None when a peer fetch is needed but no peer is connected yet."""
+        bs = self.block_store
+        if bs is None or not (bs.base <= height <= bs.height):
+            return bs is not None  # outside the live range: nothing to heal
+        tracer = self.tracer if self.tracer is not None else _trace.current()
+        with tracer.span("store.repair", height=height):
+            return self._repair_block_locked(height, timeout_s)
+
+    def _repair_block_locked(self, height: int, timeout_s: float):
+        from tendermint_tpu.types.part_set import PartSet
+
+        bs = self.block_store
+        meta = self._quiet(bs.load_block_meta, height)
+        commit = (self._quiet(bs.load_block_commit, height)
+                  or self._quiet(bs.load_seen_commit, height))
+        local = self._quiet(bs.load_block, height)
+        if local is None or commit is None:
+            peers = self._connected_peers()
+            if peers is not None and not peers:
+                return None  # p2p wired but nobody connected (boot scrub /
+                # partition): retry later without burning an attempt
+        candidates = ([local] if local is not None
+                      else self._fetch_blocks(height, timeout_s))
+        candidates = [b for b in candidates if b.header.height == height]
+        if not candidates:
+            return False
+        if commit is not None:
+            commits = [commit]
+        else:
+            nxt = self._quiet(bs.load_block, height + 1)
+            nxts = ([nxt] if nxt is not None
+                    else self._fetch_blocks(height + 1, timeout_s))
+            commits = [n.last_commit for n in nxts
+                       if n.header.height == height + 1
+                       and n.last_commit is not None]
+        if not commits:
+            return False
+        # every candidate is tried: a garbage (or malicious) fastest
+        # responder fails _verify_block and the honest copy behind it in
+        # the window still repairs this very attempt
+        seen: set = set()
+        for block in candidates:
+            bh = block.hash()
+            if bh in seen:
+                continue
+            seen.add(bh)
+            for c in commits:
+                if not self._verify_block(block, c, meta):
+                    continue
+                part_set = PartSet.from_data(block.marshal())
+                if not bs.rewrite_block(block, part_set, c):
+                    return True  # pruned while the fetch was in flight:
+                    # nothing left to heal, and no rows may be re-laid
+                return self._repaired("block")
+        return False
+
+    def _verify_block(self, block, commit, meta) -> bool:
+        height = block.header.height
+        if commit.height != height or commit.block_id.hash != block.hash():
+            return False
+        if meta is not None and meta.block_id.hash != block.hash():
+            return False  # a peer cannot replace a block we still know
+        if self.state_store is None:
+            return meta is not None  # no valset: only the meta-pinned case
+        try:
+            vals = self.state_store.load_validators(height)
+            vals.verify_commit_light(self.chain_id, commit.block_id,
+                                     height, commit)
+            return True
+        except Exception as e:  # noqa: BLE001 - unverifiable = unrepaired
+            if self.logger is not None:
+                self.logger.error("block repair verify failed",
+                                  height=height, err=e)
+            return False
+
+    def _repair_block_hash_row(self, block_hash: bytes) -> bool:
+        """Re-derive one BH index row by scanning metas for the hash."""
+        bs = self.block_store
+        if bs is None:
+            return False
+        for h in range(bs.base, bs.height + 1):
+            meta = self._quiet(bs.load_block_meta, h)
+            if meta is not None and meta.block_id.hash == block_hash:
+                bs._db.set(bs_mod._hash_key(block_hash),
+                           envelope.wrap(str(h).encode()))
+                return self._repaired("block")
+        return True  # no live height carries it: quarantined row was stale
+
+    def _connected_peers(self):
+        """Connected-peer snapshot, or None when no p2p is wired at all
+        (offline tools / pure-scrub repairers, which should fail fast
+        rather than wait for peers that can never come)."""
+        sw = self.switch
+        if sw is None:
+            return None
+        with sw._peers_mtx:
+            return list(sw.peers.values())
+
+    _FETCH_GRACE_S = 0.25
+    _MAX_OFFERS = 8
+
+    def _fetch_blocks(self, height: int, timeout_s: float) -> list:
+        """One bounded peer fetch over the fast-sync wire protocol. The
+        blockchain reactor's receive() feeds BlockResponse messages to
+        :meth:`offer_block`. EVERY response landing in the window is
+        collected (first response opens a short straggler grace) so a
+        fast garbage responder cannot crowd out honest copies — the
+        caller verifies each candidate; verification, not arrival order,
+        picks the winner."""
+        peers = self._connected_peers()
+        if not peers:
+            return []
+        from tendermint_tpu.blockchain import reactor as bc
+
+        ev = threading.Event()
+        slot: list = []
+        with self._lock:
+            self._waiters.setdefault(height, []).append((ev, slot))
+        try:
+            for p in peers[:4]:
+                p.try_send(bc.BLOCKCHAIN_CHANNEL, bc.msg_block_request(height))
+            deadline = time.monotonic() + timeout_s
+            while not slot:
+                left = deadline - time.monotonic()
+                if left <= 0 or not ev.wait(left):
+                    break
+                ev.clear()
+            if slot:  # let slower honest responses join the candidate set
+                time.sleep(min(self._FETCH_GRACE_S,
+                               max(0.0, deadline - time.monotonic())))
+            with self._lock:
+                return list(slot)
+        finally:
+            with self._lock:
+                ws = self._waiters.get(height, [])
+                if (ev, slot) in ws:
+                    ws.remove((ev, slot))
+                if not ws:
+                    self._waiters.pop(height, None)
+
+    def offer_block(self, peer_id: str, block) -> bool:
+        """Called by the blockchain reactors for every BlockResponse: hand
+        the block to any repair fetch waiting on its height. Returns True
+        when a waiter consumed it."""
+        if not self._waiters:  # lock-free fast path: fast sync delivers
+            return False       # thousands of responses with nobody waiting
+        h = getattr(getattr(block, "header", None), "height", None)
+        if h is None:
+            return False
+        with self._lock:
+            ws = list(self._waiters.get(h, ()))
+            for ev, slot in ws:
+                if len(slot) < self._MAX_OFFERS:
+                    slot.append(block)
+                ev.set()
+        return bool(ws)
+
+    # --- state repair: replay-from-blockstore / statesync verdict -----------
+
+    def repair_state(self) -> bool:
+        ss, bs = self.state_store, self.block_store
+        if ss is None:
+            return False
+        st = self._quiet(ss.load)
+        if st is not None and st.last_block_height > 0:
+            return True  # a later save already rewrote the row
+        rebuilt = rebuild_state_from_blockstore(ss, bs) if bs is not None else None
+        if rebuilt is None:
+            # the block store cannot support a rebuild: hand the verdict to
+            # the node's state-sync bootstrap (docs/DURABILITY.md)
+            self.needs_statesync = True
+            return bool(bs is None or bs.height == 0)
+        from tendermint_tpu.state import store as ss_mod
+
+        ss._set(b"stateKey", ss_mod._marshal_state(rebuilt))
+        return self._repaired("state")
+
+    def _repair_validators_row(self, height: int) -> bool:
+        """Rewrite one validator-history row from unambiguous sources: the
+        live state row's three sets (tip window), or a NEXT-row back-pointer
+        that proves nothing changed at ``height``. Anything ambiguous stays
+        quarantined (reads raise ErrNoValSetForHeight — missing, not rot)."""
+        ss = self.state_store
+        if ss is None:
+            return False
+        st = self._quiet(ss.load)
+        if st is not None and st.last_block_height > 0:
+            tip = st.last_block_height
+            window = {tip: st.last_validators, tip + 1: st.validators,
+                      tip + 2: st.next_validators}
+            vals = window.get(height)
+            if vals is not None and not vals.is_nil_or_empty():
+                ss.rewrite_validators(height, height, vals)
+                return self._repaired("state")
+        nxt = self._quiet(ss.validators_last_changed, height + 1)
+        if nxt is not None and nxt < height:
+            ss.rewrite_validators(height, nxt, None)
+            return self._repaired("state")
+        return True  # quarantined; not re-derivable without ambiguity
+
+    def _repair_params_row(self, height: int) -> bool:
+        ss = self.state_store
+        if ss is None:
+            return False
+        st = self._quiet(ss.load)
+        if st is not None and st.last_block_height > 0:
+            if height == st.last_block_height + 1:
+                ss._save_params(height, height, st.consensus_params)
+                return self._repaired("state")
+        return True  # quarantined; later loads fall back typed-missing
+
+    # --- evidence repair ----------------------------------------------------
+
+    def _restore_committed_marker(self, key: bytes) -> bool:
+        """Rewrite the canonical ``c:<hash>`` committed marker. Its value
+        is a constant and ``EvidencePool.is_committed`` only tests key
+        PRESENCE, so the row's rot was harmless — but the quarantine
+        deleted the key, which would let the same evidence commit twice.
+        The key itself carries all the data; restoring it is exact."""
+        if self.evidence_db is None:
+            return True  # nothing wired; nothing to restore into
+        self.evidence_db.set(key, envelope.wrap(b"\x01"))
+        return self._repaired("evidence")
+
+    # --- tx-index repair ----------------------------------------------------
+
+    def _reindex_height(self, height: int) -> bool:
+        """Re-derive the tx documents, event postings, and blkh/ row of one
+        height from the block + ABCI-responses stores (those rows are pure
+        functions of them; blk/ block-event postings are not — see
+        _task_key — and never reach here)."""
+        if (self.tx_indexer is None or self.block_store is None
+                or self.state_store is None):
+            return True  # nothing wired to rebuild into; quarantine stands
+        block = self._quiet(self.block_store.load_block, height)
+        if block is None:
+            return True  # pruned height: stale index rows stay quarantined
+        try:
+            resp = self.state_store.load_abci_responses(height)
+        except Exception:  # noqa: BLE001 - responses gone: quarantine stands
+            return True
+        for i, tx in enumerate(block.data.txs):
+            result = (resp.deliver_txs[i] if i < len(resp.deliver_txs)
+                      else None)
+            self.tx_indexer.index(height, i, tx, result)
+        if self.block_indexer is not None:
+            self.block_indexer.index(height, [], [])
+        return self._repaired("txindex")
+
+    def _reindex_doc(self, tx_hash: bytes) -> bool:
+        """Recover a quarantined ``txr/`` document: the tx.height posting's
+        VALUE is this hash, so an intact posting names the height to
+        re-derive. No surviving posting => quarantine stands."""
+        if self.tx_indexer is None:
+            return True
+        from tendermint_tpu.store.db import prefix_end
+
+        prefix = b"txe/tx.height/"
+        for k, v in list(self.tx_indexer._db.iterator(prefix,
+                                                      prefix_end(prefix))):
+            try:
+                if envelope.unwrap(v, "txindex", k) != tx_hash:
+                    continue
+                height = int(k.split(b"/")[3])
+            except Exception:  # noqa: BLE001 - a rotten posting has its
+                continue       # own repair task; skip it here
+            return self._reindex_height(height)
+        return True
+
+    @staticmethod
+    def _quiet(fn, *args):
+        """A load that treats corrupt exactly like missing (the hook has
+        already quarantined + scheduled it)."""
+        try:
+            return fn(*args)
+        except Exception:  # noqa: BLE001
+            return None
+
+
+# --- state reconstruction ----------------------------------------------------
+
+
+def rebuild_state_from_blockstore(state_store, block_store):
+    """Rollback-style reconstruction of the state row at tip-1 from intact
+    block-store + state-history rows (state/rollback.py mirrored forward):
+    ``app_hash`` after tip-1 is carried by the tip header, so the rebuilt
+    row is exact, and the startup handshake replays the final block through
+    the app to reach the tip ("replay-from-blockstore"). Returns None when
+    the block store cannot support the rebuild (empty, pruned past tip-1,
+    or its own rows are damaged) — the caller falls back to a state-sync
+    re-bootstrap."""
+    from dataclasses import replace as _replace
+
+    from tendermint_tpu.state.state import State
+
+    h = block_store.height
+    if h < 2 or block_store.base > h - 1:
+        return None
+    try:
+        tip_meta = block_store.load_block_meta(h)
+        prev_meta = block_store.load_block_meta(h - 1)
+        if tip_meta is None or prev_meta is None:
+            return None
+        target = h - 1
+        last_vals = state_store.load_validators(target)
+        curr_vals = state_store.load_validators(target + 1)
+        next_vals = state_store.load_validators(target + 2)
+        params = state_store.load_consensus_params(target + 1)
+        vals_changed = state_store.validators_last_changed(target + 1)
+        params_changed = state_store.params_last_changed(target + 1)
+    except Exception:  # noqa: BLE001 - any gap means no exact rebuild
+        return None
+    return _replace(
+        State(),
+        version=tip_meta.header.version,
+        chain_id=tip_meta.header.chain_id,
+        last_block_height=target,
+        last_block_id=prev_meta.block_id,
+        last_block_time=prev_meta.header.time,
+        validators=curr_vals,
+        next_validators=next_vals,
+        last_validators=last_vals,
+        last_height_validators_changed=vals_changed or target + 1,
+        consensus_params=params,
+        last_height_consensus_params_changed=params_changed or target + 1,
+        app_hash=tip_meta.header.app_hash,
+        # results(target) live in the TIP header (header h commits the
+        # results of h-1); prev_meta's would be results(target-1) and the
+        # handshake's replay of the tip block would fail validate_block
+        last_results_hash=tip_meta.header.last_results_hash,
+    )
+
+
+def recover_state(state_store, block_store, logger=None,
+                  statesync_enabled: bool = False):
+    """Node-construction guard around the very first ``StateStore.load()``:
+    a corrupt state row is quarantined and rebuilt from the block store
+    when possible; otherwise an empty State comes back, which routes the
+    node into the normal bootstrap — genesis + full replay when the block
+    store is unpruned, state-sync (it activates on last_block_height == 0)
+    when enabled. A PRUNED block store with state sync disabled refuses to
+    boot typed instead: the handshake would silently replay from ``base``
+    into a fresh app, skipping heights ``1..base-1`` and diverging."""
+    try:
+        return state_store.load()
+    except envelope.CorruptedStoreError as err:
+        rebuilt = rebuild_state_from_blockstore(state_store, block_store)
+        pruned = (block_store is not None and block_store.height > 0
+                  and block_store.base > 1)
+        if rebuilt is None and pruned and not statesync_enabled:
+            # refuse BEFORE quarantining: deleting the row would make the
+            # next boot see *missing*, take the genesis path, and diverge
+            # silently — leave it so every retry fails typed until the
+            # operator enables statesync or restores from backup
+            raise envelope.CorruptedStoreError(
+                "state", b"stateKey",
+                "state row unrebuildable and the block store is pruned "
+                f"(base {block_store.base}): genesis replay cannot cover "
+                "the gap — enable statesync to re-bootstrap, or restore "
+                "from backup", err.raw) from err
+        envelope.quarantine(state_store._db, err)
+        if rebuilt is not None:
+            from tendermint_tpu.state import store as ss_mod
+
+            state_store._set(b"stateKey", ss_mod._marshal_state(rebuilt))
+            envelope.count_repair("state")
+            if logger is not None:
+                logger.error("state row corrupt; rebuilt from block store",
+                             height=rebuilt.last_block_height)
+            return rebuilt
+        if logger is not None:
+            logger.error("state row corrupt and not rebuildable; "
+                         "falling back to bootstrap", err=str(err))
+        from tendermint_tpu.state.state import State
+
+        return State()

@@ -1,0 +1,357 @@
+"""Storage-plane scrubber: walk every store, verify every record, report —
+and optionally hand the damage to the repairer (docs/DURABILITY.md).
+
+The scrubber is the offline/startup/on-demand half of the self-healing
+plane: where the envelope (store/envelope.py) catches corruption lazily on
+the next read, a scrub pass proactively decodes EVERY row of the block,
+state, evidence, and tx-index stores — so at-rest bit rot is found before
+a peer asks for the block, and the operator gets a full damage map from
+one ``unsafe_scrub`` RPC call instead of a trickle of read errors.
+
+Structure checks beyond the CRC:
+
+* block store: every height in ``[base, height]`` must have a decodable
+  meta, all ``part_set_header.total`` parts, and a BH index row that
+  points back at it; dangling BH rows (pruning leftovers, stale hashes)
+  are flagged.  Heights below ``base`` are a **pruned gap — healthy**, not
+  corruption.
+* state store: the state row plus every validator / consensus-params /
+  ABCI-responses history row decodes; full validator rows unmarshal to a
+  ValidatorSet.
+* evidence / tx-index: every row decodes under its expected shape.
+
+Detected corruption is quarantined on the spot (the record moves to the
+``Q:`` keyspace, so nothing can serve it) and, when a
+:class:`~tendermint_tpu.store.repair.StoreRepairer` is supplied, scheduled
+for repair — blocks re-fetched from peers and batch-kernel re-verified,
+state rebuilt from the block store, index rows re-derived.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from tendermint_tpu.encoding import proto
+from tendermint_tpu.store import envelope
+from tendermint_tpu.store import block_store as bs_mod
+from tendermint_tpu.store.db import prefix_end
+from tendermint_tpu.types.block import Commit
+from tendermint_tpu.types.part_set import Part
+from tendermint_tpu.utils import trace as _trace
+
+
+@dataclass
+class Corruption:
+    store: str
+    key: bytes
+    reason: str
+    height: int | None = None
+
+    def describe(self) -> str:
+        at = f" (height {self.height})" if self.height is not None else ""
+        return f"{self.store}:{self.key!r}{at}: {self.reason}"
+
+
+@dataclass
+class ScrubReport:
+    checked: int = 0
+    corruptions: list = field(default_factory=list)   # [Corruption]
+    repaired: list = field(default_factory=list)      # [str]
+    unrepaired: list = field(default_factory=list)    # [str]
+    pruned_gap_heights: int = 0
+    duration_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.corruptions
+
+    @property
+    def healthy_after_repair(self) -> bool:
+        return not self.unrepaired
+
+    def as_dict(self) -> dict:
+        return {
+            "checked": self.checked,
+            "corruptions": [c.describe() for c in self.corruptions],
+            "repaired": list(self.repaired),
+            "unrepaired": list(self.unrepaired),
+            "pruned_gap_heights": self.pruned_gap_heights,
+            "duration_s": round(self.duration_s, 4),
+            "ok": self.ok,
+        }
+
+
+class Scrubber:
+    """One pass over a node's stores. Every store handle is optional so the
+    scrubber composes with partial wiring (offline tools, tests, nodes
+    without an indexer)."""
+
+    def __init__(self, block_store=None, state_store=None, evidence_db=None,
+                 txindex_db=None, tracer=None):
+        self.block_store = block_store
+        self.state_store = state_store
+        self.evidence_db = evidence_db
+        self.txindex_db = txindex_db
+        self.tracer = tracer
+
+    # --- the pass -----------------------------------------------------------
+
+    def scrub(self, repairer=None, repair_timeout_s: float = 10.0,
+              drain: bool = True) -> ScrubReport:
+        """Walk everything; quarantine + report every bad record. With a
+        ``repairer``, schedule each finding and — unless ``drain=False``
+        (startup / soak: let the background worker retry once peers exist)
+        — synchronously drain the repair queue (peer fetches bounded by
+        ``repair_timeout_s``). Without a repairer the quarantine is
+        PERMANENT for everything except the presence-only evidence
+        committed markers (restored inline: their loss would re-open a
+        double-commit window) — that mode is for the offline matrix and
+        diagnostics; every production caller supplies the node's
+        repairer."""
+        report = ScrubReport()
+        t0 = time.monotonic()
+        tracer = self.tracer if self.tracer is not None else _trace.current()
+        with tracer.span("store.scrub"):
+            if self.block_store is not None:
+                self._scrub_block_store(report)
+            if self.state_store is not None:
+                self._scrub_state_store(report)
+            if self.evidence_db is not None:
+                self._scrub_simple(report, self.evidence_db, "evidence")
+            if self.txindex_db is not None:
+                self._scrub_simple(report, self.txindex_db, "txindex")
+            if repairer is not None and report.corruptions:
+                for c in report.corruptions:
+                    repairer.note(envelope.CorruptedStoreError(
+                        c.store, c.key, c.reason), spawn=not drain)
+                if drain:
+                    done, failed = repairer.repair_pending(
+                        timeout_s=repair_timeout_s)
+                    report.repaired = done
+                    report.unrepaired = failed
+            elif report.corruptions:
+                self._restore_evidence_markers(report)
+        report.duration_s = time.monotonic() - t0
+        try:
+            from tendermint_tpu.utils import metrics as tmmetrics
+
+            if tmmetrics.GLOBAL_NODE_METRICS is not None:
+                tmmetrics.GLOBAL_NODE_METRICS.store_scrub_runs.add(1)
+        except Exception:  # noqa: BLE001 - metrics never gate a scrub
+            pass
+        return report
+
+    def _restore_evidence_markers(self, report: ScrubReport) -> None:
+        """No-repairer quarantine must not eat `c:<hash>` committed
+        markers: `is_committed` tests key PRESENCE only, so a missing
+        marker re-opens a double-commit window for that evidence. The
+        value is a constant and the key carries all the data — the restore
+        is exact and needs no repairer (repair.py's
+        _restore_committed_marker does the same on the scheduled path)."""
+        if self.evidence_db is None:
+            return
+        for c in report.corruptions:
+            if c.store == "evidence" and c.key.startswith(b"c"):
+                self.evidence_db.set(c.key, envelope.wrap(b"\x01"))
+                envelope.count_repair("evidence")
+                report.repaired.append(f"evidence_marker:{c.key!r}")
+
+    # --- per-store walks ----------------------------------------------------
+
+    def _flag(self, report: ScrubReport, db, store: str, key: bytes,
+              reason: str, height: int | None = None,
+              raw: bytes | None = None) -> None:
+        report.corruptions.append(Corruption(store, key, reason, height))
+        err = envelope.CorruptedStoreError(store, key, reason, raw)
+        envelope.count_detection(store)
+        if raw is not None or db.get(key) is not None:
+            envelope.quarantine(db, err)
+
+    def _check(self, report: ScrubReport, db, store: str, key: bytes,
+               raw: bytes, fn, height: int | None = None) -> object | None:
+        """Decode one row; on failure flag + quarantine, return None."""
+        report.checked += 1
+        try:
+            return fn(envelope.unwrap(raw, store, key))
+        except envelope.CorruptedStoreError as e:
+            self._flag(report, db, store, key, e.reason, height, raw)
+        except Exception as e:  # noqa: BLE001 - decode blow-up IS corruption
+            self._flag(report, db, store, key, f"decode failed: {e!r}",
+                       height, raw)
+        return None
+
+    def _scrub_block_store(self, report: ScrubReport) -> None:
+        bs = self.block_store
+        db = bs._db
+        base, height = bs.base, bs.height
+        if height == 0:
+            return  # nothing ever saved: a fresh store is healthy
+        report.pruned_gap_heights = max(0, base - 1)
+        hash_to_height: dict[bytes, int] = {}
+        for h in range(max(base, 1), height + 1):
+            if h < bs.base:
+                continue  # pruned while the scrub was walking: healthy gap
+            mkey = bs_mod._meta_key(h)
+            raw = db.get(mkey)
+            meta = None
+            if raw is None:
+                if h < bs.base:
+                    continue  # prune_blocks won the race for this height
+                self._flag(report, db, "block", mkey, "missing meta row", h)
+            else:
+                meta = self._check(report, db, "block", mkey, raw,
+                                   bs_mod.BlockMeta.unmarshal, h)
+            if meta is None:
+                # the meta can no longer vouch for part count or hash:
+                # decode whatever rows the height still has by prefix scan
+                pp = b"P:%020d:" % h
+                for k, v in list(db.iterator(pp, prefix_end(pp))):
+                    self._check(report, db, "block", k, v, Part.unmarshal, h)
+                for ckey in (bs_mod._commit_key(h),
+                             bs_mod._seen_commit_key(h)):
+                    craw = db.get(ckey)
+                    if craw is not None:
+                        self._check(report, db, "block", ckey, craw,
+                                    Commit.unmarshal, h)
+                continue
+            hash_to_height[meta.block_id.hash] = h
+            for i in range(meta.block_id.part_set_header.total):
+                pkey = bs_mod._part_key(h, i)
+                praw = db.get(pkey)
+                if praw is None:
+                    if h >= bs.base:  # not a concurrent prune: real damage
+                        self._flag(report, db, "block", pkey,
+                                   "missing part row", h)
+                    continue
+                part = self._check(report, db, "block", pkey, praw,
+                                   Part.unmarshal, h)
+                if part is not None and len(part.bytes_) == 0:
+                    self._flag(report, db, "block", pkey, "empty part", h)
+            for ckey in (bs_mod._commit_key(h), bs_mod._seen_commit_key(h)):
+                craw = db.get(ckey)
+                if craw is not None:
+                    self._check(report, db, "block", ckey, craw,
+                                Commit.unmarshal, h)
+            bh_key = bs_mod._hash_key(meta.block_id.hash)
+            braw = db.get(bh_key)
+            if braw is None:
+                if h >= bs.base:
+                    self._flag(report, db, "block", bh_key,
+                               "missing BH index row", h)
+            else:
+                got = self._check(report, db, "block", bh_key, braw,
+                                  envelope.decimal_height, h)
+                if got is not None and got != h:
+                    self._flag(report, db, "block", bh_key,
+                               f"BH index points at {got}, expected {h}", h)
+        # dangling BH rows: an index entry must resolve to a live height
+        # whose meta carries the same hash (stale rows from the pruning
+        # path or rot in the hash bytes themselves). The walk above used a
+        # base/height SNAPSHOT, but the default-on boot scrub runs while
+        # consensus keeps committing and pruning — so re-read the live
+        # bounds here: a block committed after the snapshot is healthy
+        # growth, not an "unknown height", and a height pruned mid-scrub
+        # legitimately lost its rows.
+        for k, v in list(db.iterator(b"BH:", prefix_end(b"BH:"))):
+            try:
+                h = envelope.decimal_height(envelope.unwrap(v, "block", k))
+            except Exception:  # noqa: BLE001 - flagged above if in range
+                continue
+            if h > height:
+                if h > bs.height:
+                    self._flag(report, db, "block", k,
+                               f"BH index row for unknown height {h}", h)
+            elif h < bs.base:
+                if db.get(k) is not None:  # survived its height's pruning
+                    self._flag(report, db, "block", k,
+                               f"BH index row for pruned height {h}", h)
+            elif h >= base and hash_to_height.get(k[3:]) != h:
+                self._flag(report, db, "block", k,
+                           f"dangling BH index row -> height {h}", h)
+
+    def _scrub_state_store(self, report: ScrubReport) -> None:
+        from tendermint_tpu.state import store as ss_mod
+
+        ss = self.state_store
+        db = ss._db
+        raw = db.get(b"stateKey")
+        if raw is not None:
+            self._check(report, db, "state", b"stateKey", raw,
+                        ss_mod._unmarshal_state)
+        for prefix, label in ((b"validatorsKey:", "validators"),
+                              (b"consensusParamsKey:", "params"),
+                              (b"abciResponsesKey:", "abci")):
+            for k, v in list(db.iterator(prefix, prefix_end(prefix))):
+                h = _height_suffix(k)
+                if label == "abci":
+                    # the exact decoder the read path runs — top-level
+                    # proto.fields would pass rot inside a nested
+                    # ResponseDeliverTx that load_abci_responses rejects
+                    self._check(report, db, "state", k, v,
+                                ss_mod.ABCIResponses.unmarshal, h)
+                    continue
+                f = self._check(report, db, "state", k, v, proto.fields, h)
+                if f is None:
+                    continue
+                if 1 in f:
+                    try:
+                        if label == "validators":
+                            from tendermint_tpu.types.validator_set import (
+                                ValidatorSet)
+
+                            ValidatorSet.unmarshal(f[1][-1])
+                        else:
+                            from tendermint_tpu.types.params import (
+                                ConsensusParams)
+
+                            ConsensusParams.unmarshal(f[1][-1])
+                    except Exception as e:  # noqa: BLE001
+                        self._flag(report, db, "state", k,
+                                   f"{label} payload decode failed: {e!r}",
+                                   h, v)
+
+    def _scrub_simple(self, report: ScrubReport, db, store: str) -> None:
+        if store == "evidence":
+            from tendermint_tpu.types.evidence import evidence_unmarshal
+
+            for k, v in list(db.iterator(b"p", b"q")):
+                self._check(report, db, store, k, v, evidence_unmarshal)
+            for k, v in list(db.iterator(b"c", b"d")):
+                self._check(report, db, store, k, v, _committed_marker)
+            return
+        import json
+
+        from tendermint_tpu.state.txindex import _height_str, _posting_hash
+
+        for k, v in list(db.iterator(b"txr/", prefix_end(b"txr/"))):
+            self._check(report, db, store, k, v, json.loads)
+        for k, v in list(db.iterator(b"txe/", prefix_end(b"txe/"))):
+            self._check(report, db, store, k, v, _posting_hash)
+        for prefix in (b"blk/", b"blkh/"):
+            for k, v in list(db.iterator(prefix, prefix_end(prefix))):
+                self._check(report, db, store, k, v, _height_str)
+
+
+def _committed_marker(b: bytes) -> bytes:
+    """Strict decode of the evidence committed marker: exactly b"\x01".
+    Anything else (e.g. a magic-byte flip demoting a framed row to the
+    legacy path) is corruption."""
+    if b != b"\x01":
+        raise ValueError(f"committed marker is {b!r}, want b'\\x01'")
+    return b
+
+
+def _height_suffix(key: bytes) -> int | None:
+    try:
+        return int(key.rsplit(b":", 1)[-1])
+    except ValueError:
+        return None
+
+
+def scrub_on_start_enabled() -> bool:
+    """TMTPU_SCRUB_ON_START gates the node's boot-time scrub pass
+    (default on; `0` skips it — docs/CONFIG.md)."""
+    import os
+
+    return os.environ.get("TMTPU_SCRUB_ON_START", "1") != "0"

@@ -34,9 +34,14 @@ Actions:
   * ``torn``       -- (write sites) append a prefix of the frame cut inside
     the BODY, fsync, then crash: a torn frame on disk.
   * ``partial``    -- like ``torn`` but cut inside the length/crc header.
-  * ``drop``       -- (message sites) silently discard the message.
+  * ``drop``       -- (message sites) silently discard the message; at a
+    record-read site the row reads back as missing.
   * ``disconnect`` -- (p2p sites) raise :class:`FaultDisconnect`, which the
     connection error path turns into a peer teardown.
+  * ``bitrot``     -- (record-read sites, ``store.*.load``) flip one
+    deterministic bit in the value on its way out of the DB.
+  * ``truncate``   -- (record-read sites) cut the value to a deterministic
+    prefix — a torn at-rest record.
 
 The legacy ``TMTPU_FAIL_INDEX`` global-counter contract of utils/fail.py is
 preserved verbatim by :func:`fail_point` (the crash matrix in
@@ -81,6 +86,15 @@ CANONICAL_SITES: dict[str, str] = {
     "store.block.save": "before BlockStore.save_block's atomic batch write",
     "store.state.save": "before StateStore.save writes the state key "
                         "(after the validator/params history rows)",
+    "store.block.load": "every BlockStore record read (meta/part/commit/"
+                        "seen-commit/BH/state rows), pre-decode; bitrot/"
+                        "truncate mutate the value in flight, drop loses it",
+    "store.state.load": "every StateStore record read (state key, validator/"
+                        "params history, ABCI responses), pre-decode",
+    "store.evidence.load": "every evidence-pool record read (pending/"
+                           "committed rows), pre-decode",
+    "store.txindex.load": "every tx/block-indexer record read (documents "
+                          "and event postings), pre-decode",
     "p2p.send": "outbound MConnection message (drop/delay/disconnect)",
     "p2p.recv": "inbound MConnection message, pre-delivery "
                 "(drop/delay/disconnect)",
@@ -116,7 +130,8 @@ _SPEC_RE = re.compile(
     r"(?:x(?P<times>\d+))?$"
 )
 
-_ACTIONS = {"crash", "raise", "delay", "torn", "partial", "drop", "disconnect"}
+_ACTIONS = {"crash", "raise", "delay", "torn", "partial", "drop", "disconnect",
+            "bitrot", "truncate"}
 
 
 @dataclass
@@ -384,6 +399,69 @@ def link_outcome(site: str, local: str = "", remote: str = "",
     if not nemesis.PLANE.active:
         return "pass"
     return nemesis.PLANE.outcome(site, local, remote, channel)
+
+
+def mutate_value(site: str, value: bytes | None) -> bytes | None:
+    """Record-read sites (store.*.load): apply a bit-rot / truncation rule
+    to the value on its way out of the DB — what the integrity envelope
+    (store/envelope.py) exists to catch. Returns the value unchanged when
+    no rule fires; missing rows (None) never consume a hit (a row that is
+    not there cannot rot).
+
+    * ``bitrot``   -- flip ONE deterministic bit (``~p`` pins the byte
+      index; otherwise seeded from (seed, site, hit)).
+    * ``truncate`` -- cut the value to a deterministic prefix, possibly
+      empty (``~p`` pins the cut length).
+    * ``drop``     -- the record reads back as missing.
+    * crash/raise/delay apply as at any other site.
+    """
+    if value is None:
+        return None
+    hit = REGISTRY.check(site)
+    if hit is None:
+        return value
+    if hit.action == "bitrot":
+        if not value:
+            return value
+        if hit.rule.param is not None:
+            pos = min(int(hit.rule.param), len(value) - 1)
+            bit = 0
+        else:
+            pos = hit.rng.randrange(len(value))
+            bit = hit.rng.randrange(8)
+        return value[:pos] + bytes([value[pos] ^ (1 << bit)]) + value[pos + 1:]
+    if hit.action == "truncate":
+        if hit.rule.param is not None:
+            cut = min(int(hit.rule.param), len(value))
+        else:
+            cut = hit.rng.randrange(len(value)) if value else 0
+        return value[:cut]
+    if hit.action == "drop":
+        return None
+    _apply(hit)
+    return value
+
+
+def corrupt_db(db, key: bytes, mode: str = "bitrot", seed: int = 0) -> bytes:
+    """Offline at-rest mutation: deterministically bit-rot or truncate the
+    stored value at ``key`` IN the DB (the scrub matrix and the soak
+    ``bitrot`` perturbation drive this — a live rule mutates reads in
+    flight, this damages the bytes on disk). Returns the original value so
+    a harness can assert the repaired row is byte-identical."""
+    raw = db.get(key)
+    if raw is None:
+        raise FaultError(f"corrupt_db: no record at key {key!r}")
+    rng = random.Random(f"{seed}:corrupt_db:{mode}:{key.hex()}")
+    if mode == "bitrot":
+        pos = rng.randrange(len(raw))
+        bit = rng.randrange(8)
+        db.set(key, raw[:pos] + bytes([raw[pos] ^ (1 << bit)]) + raw[pos + 1:])
+    elif mode == "truncate":
+        db.set(key, raw[:rng.randrange(len(raw))])
+    else:
+        raise FaultError(f"corrupt_db: unknown mode {mode!r} "
+                         "(want bitrot|truncate)")
+    return raw
 
 
 def torn_write(site: str, fobj, frame: bytes) -> None:

@@ -296,6 +296,21 @@ class NodeMetrics:
             "nemesis", "fired_total",
             "Nemesis link-plane firings by site and action "
             "('cut' = partition).", labels=("site", "action"))
+        # self-healing storage plane (store/envelope.py, store/scrub.py,
+        # store/repair.py, docs/DURABILITY.md): label universe is the
+        # closed store table (envelope.STORES), fully pre-seeded below
+        self.store_corruption_detected = r.counter(
+            "store", "corruption_detected_total",
+            "Store records that failed an integrity check (CRC envelope "
+            "or guarded decode), by store.", labels=("store",))
+        self.store_corruption_repaired = r.counter(
+            "store", "corruption_repaired_total",
+            "Corrupt store records healed (peer re-fetch + batch-verified "
+            "rewrite, state rebuild, reindex, or quarantine-is-repair).",
+            labels=("store",))
+        self.store_scrub_runs = r.counter(
+            "store", "scrub_runs_total",
+            "Completed scrub passes (startup + unsafe_scrub RPC).")
         self.breaker_open = r.gauge(
             "ops", "breaker_open",
             "1 while the kernel's device circuit breaker is open.",
@@ -327,6 +342,13 @@ class NodeMetrics:
         # bounded by the node's channel table, first traffic creates them)
         self.peer_receive_bytes.add(0.0, chID="")
         self.peer_send_bytes.add(0.0, chID="")
+        # the storage-plane counters' label universe IS envelope.STORES
+        from tendermint_tpu.store.envelope import STORES as _stores
+
+        self.store_scrub_runs.add(0.0)
+        for store in _stores:
+            self.store_corruption_detected.add(0.0, store=store)
+            self.store_corruption_repaired.add(0.0, store=store)
         # the device-breaker pair has a two-kernel label universe: seed it
         # fully so "breaker never tripped" is an explicit 0, not absence
         for kernel in ("ed25519", "sr25519"):

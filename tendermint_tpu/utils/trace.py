@@ -88,6 +88,10 @@ CANONICAL_SPANS = {
                            "ingest coalescer",
     "p2p.send": "message queued to a peer channel (mark)",
     "p2p.recv": "message delivered to a reactor (span over on_receive)",
+    # self-healing storage plane (store/scrub.py, store/repair.py)
+    "store.scrub": "one integrity-scrub pass over a node's stores (span)",
+    "store.repair": "peer re-fetch + batch-verified rewrite of one damaged "
+                    "height (span; height= tag)",
 }
 
 # Spans mirrored into the pre-seeded `trace_phase_seconds{phase=}`

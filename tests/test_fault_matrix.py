@@ -56,10 +56,20 @@ DEGRADE_SITES = {
     "p2p.recv": "disconnect action unit test (chaos knob for e2e)",
     "p2p.dial": "reconnect backoff schedule test (chaos knob for e2e)",
     "abci.call": "chaos knob for socket-app runs (in-proc apps bypass it)",
+    "mempool.ingest": "batched-CheckTx degradation to the serial loop "
+                      "(test_ingest.py + __graft_entry__.ingest_stage)",
     "consensus.finalize.end_height": "legacy TMTPU_FAIL_INDEX matrix "
                                      "(test_fastsync_recovery.py)",
     "consensus.finalize.prune": "legacy TMTPU_FAIL_INDEX matrix",
     "consensus.finalize.done": "legacy TMTPU_FAIL_INDEX matrix",
+    # the self-healing storage plane (docs/DURABILITY.md): bit-rot at the
+    # record-read sites degrades to quarantine + peer-assisted repair, not
+    # crash-recovery — owned by the durability matrix
+    "store.block.load": "test_durability.py detect/quarantine/repair matrix "
+                        "+ __graft_entry__.durability_stage",
+    "store.state.load": "test_durability.py state rebuild-from-blockstore",
+    "store.evidence.load": "test_durability.py evidence quarantine-is-repair",
+    "store.txindex.load": "test_durability.py reindex-from-stores",
 }
 
 

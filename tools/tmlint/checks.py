@@ -853,7 +853,7 @@ def check_metrics_discipline(project: Project) -> list[Finding]:
 _FAULTS_FILE = "tendermint_tpu/utils/faults.py"
 _FAULTS_DOC = "docs/FAULTS.md"
 _FIRE_FAMILY = {"fire", "maybe_drop", "link_outcome", "torn_write",
-                "crash_point", "fail_point", "check"}
+                "crash_point", "fail_point", "check", "mutate_value"}
 _SITE_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
 
 
