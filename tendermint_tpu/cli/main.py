@@ -93,10 +93,12 @@ def cmd_start(args) -> int:
         cfg.p2p.persistent_peers = args.persistent_peers
 
     node = Node(cfg)
-    mb = os.environ.get("TMTPU_MISBEHAVIOR")
+    mb = os.environ.get("TMTPU_BYZ") or os.environ.get("TMTPU_MISBEHAVIOR")
     if mb:
-        # e2e byzantine node (reference: test/maverick); honest peers must
-        # detect the equivocation and keep committing.
+        # e2e byzantine node (reference: test/maverick); TMTPU_BYZ takes a
+        # full height-windowed behavior spec (docs/BYZANTINE.md), the
+        # legacy TMTPU_MISBEHAVIOR a bare behavior name; honest peers must
+        # detect what is detectable and keep committing.
         node.install_misbehavior(mb)
     node.start()
     print(f"Started node {node.node_key.id()} p2p={node.transport.node_info.listen_addr}")

@@ -1,19 +1,88 @@
-"""Byzantine misbehavior hooks for adversarial testing (reference:
-test/maverick/consensus/misbehavior.go:16).
+"""Byzantine misbehavior suite for adversarial testing (reference:
+test/maverick/consensus/misbehavior.go:16 — the maverick node's pluggable
+misbehavior table, grown here into a behavior catalog with per-height
+scheduling; docs/BYZANTINE.md is the cookbook).
 
-Install on a ConsensusState via
-`cs.misbehaviors["prevote"] = double_prevote(node.switch)` BEFORE starting
-the node. These deliberately violate the protocol; honest peers must detect
-the equivocation (DuplicateVoteEvidence) and keep committing as long as the
-byzantine power stays below 1/3.
+Hook protocol: ``cs.misbehaviors[slot] = fn`` where slot is one of
+``"prevote"``, ``"precommit"``, ``"propose"`` and ``fn(cs, height, round)``
+returns truthy when it HANDLED the action (the state machine skips its
+default behavior) and falsy to fall through to the honest default — which
+is what lets :func:`scheduled` window a behavior to a height range while
+the node plays honest everywhere else.
+
+Install on a ConsensusState BEFORE starting the node, or at any point on a
+live node via :func:`install` (the node-level entry: swaps a
+double-sign-guarded FilePV for an unguarded MockPV with the same key,
+parses a behavior spec, and wires every slot). These deliberately violate
+the protocol; honest peers must detect what is detectable
+(DuplicateVoteEvidence for double votes, LightClientAttackEvidence for the
+lunatic's fabricated headers) and keep committing as long as the byzantine
+power stays below 1/3.
+
+Behavior catalog (spec grammar ``<behavior>[~<lo>[-<hi>]]``, ``+``-joined
+for per-height behavior maps, e.g. ``"equivocate~3-5+lunatic~7-"``):
+
+* ``double_prevote``    — two conflicting prevotes (block + nil) pushed to
+  every peer; the equivocation every honest node turns into
+  DuplicateVoteEvidence.
+* ``double_precommit``  — the precommit twin: two conflicting precommits
+  at the same H/R.
+* ``amnesia``           — "forgets" its POL lock: prevotes AND precommits
+  the current round's proposal even when locked on a different block from
+  an earlier round. No same-HRS double sign, so no DuplicateVoteEvidence —
+  the attribute-nobody case of light-attack classification
+  (types/evidence.py get_byzantine_validators).
+* ``equivocate``        — equivocating proposer: signs TWO conflicting
+  proposals for the same H/R and pushes each (proposal + full part set) to
+  a disjoint half of its peers, splitting the prevote.
+* ``lunatic``           — lunatic proposer: proposes blocks carrying a
+  fabricated app hash on the live chain (honest validators reject and the
+  round advances), and for every committed height in its window signs a
+  fabricated header (bogus app/validators hashes under a claimed
+  validator set it fully controls) served to light clients through the
+  node's ``light_block`` RPC route — the staged light-client attack
+  (docs/BYZANTINE.md cookbook; reference: light/detector.go's lunatic
+  taxonomy).
+* ``absent`` / ``absent_prevote`` — a silent validator.
 """
 
 from __future__ import annotations
 
-from tendermint_tpu.consensus.reactor import VOTE_CHANNEL, msg_vote
-from tendermint_tpu.consensus.state_machine import MsgInfo, VoteMessage
-from tendermint_tpu.types.block_id import PartSetHeader
-from tendermint_tpu.types.vote import PREVOTE_TYPE
+import dataclasses
+from dataclasses import dataclass
+
+from tendermint_tpu.consensus.reactor import (
+    DATA_CHANNEL,
+    VOTE_CHANNEL,
+    msg_block_part,
+    msg_proposal,
+    msg_vote,
+)
+from tendermint_tpu.consensus.state_machine import (
+    BlockPartMessage,
+    MsgInfo,
+    ProposalMessage,
+    VoteMessage,
+)
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE
+
+FABRICATED_APP_HASH = b"\xba\xad\xf0\x0d" * 8
+
+
+def _peers(switch) -> list:
+    with switch._peers_mtx:
+        return sorted(switch.peers.values(), key=lambda p: p.id)
+
+
+def _push_votes(switch, votes) -> None:
+    for p in _peers(switch):
+        for v in votes:
+            if v is not None:
+                p.try_send(VOTE_CHANNEL, msg_vote(v))
 
 
 def double_prevote(switch):
@@ -23,14 +92,15 @@ def double_prevote(switch):
     misbehavior.go:93-118).
 
     Requires a signer without a double-sign guard (MockPV); FilePV would
-    refuse the second signature -- which is itself worth testing.
+    refuse the second signature -- which is itself worth testing
+    (tests/test_byzantine.py test_filepv_refuses_equivocating_signature).
     """
 
-    def hook(cs, height: int, round_: int) -> None:
+    def hook(cs, height: int, round_: int) -> bool:
         rs = cs.rs
         if rs.proposal_block is None:
             cs._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
-            return
+            return True
         vote_a = cs._sign_vote(PREVOTE_TYPE, rs.proposal_block.hash(),
                                rs.proposal_block_parts.header())
         vote_b = cs._sign_vote(PREVOTE_TYPE, b"", PartSetHeader())
@@ -40,16 +110,369 @@ def double_prevote(switch):
             cs._internal_queue.put(MsgInfo(VoteMessage(vote_a), ""))
         # Gossip only ever serves votes from our own vote set, so the
         # equivocating pair must be PUSHED to peers over the wire.
-        with switch._peers_mtx:
-            peers = list(switch.peers.values())
-        for v in (vote_a, vote_b):
-            if v is None:
-                continue
-            for p in peers:
-                p.try_send(VOTE_CHANNEL, msg_vote(v))
+        _push_votes(switch, (vote_a, vote_b))
+        return True
 
     return hook
 
 
-def absent_prevote(cs, height: int, round_: int) -> None:
+def double_precommit(switch):
+    """The precommit twin of :func:`double_prevote`: two conflicting
+    precommits (proposal block + nil) at the same H/R, both pushed to every
+    peer. Honest vote sets raise ErrVoteConflictingVotes and the pair lands
+    in the evidence pool as DuplicateVoteEvidence."""
+
+    def hook(cs, height: int, round_: int) -> bool:
+        rs = cs.rs
+        if rs.proposal_block is None:
+            cs._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
+            return True
+        vote_a = cs._sign_vote(PRECOMMIT_TYPE, rs.proposal_block.hash(),
+                               rs.proposal_block_parts.header())
+        vote_b = cs._sign_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
+        if vote_a is not None:
+            cs._internal_queue.put(MsgInfo(VoteMessage(vote_a), ""))
+        _push_votes(switch, (vote_a, vote_b))
+        return True
+
+    return hook
+
+
+def absent_prevote(cs, height: int, round_: int) -> bool:
     """Never prevote (a silent validator)."""
+    return True
+
+
+def amnesia_prevote(cs, height: int, round_: int) -> bool:
+    """Forget the POL lock: prevote the CURRENT proposal block even when
+    locked on a different one from an earlier round (the maverick's
+    amnesia — prevote one block in round r, precommit another in r' > r;
+    no same-HRS double sign, so evidence attribution comes up empty)."""
+    rs = cs.rs
+    if rs.proposal_block is None:
+        cs._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
+    else:
+        cs._sign_add_vote(PREVOTE_TYPE, rs.proposal_block.hash(),
+                          rs.proposal_block_parts.header())
+    return True
+
+
+def amnesia_precommit(cs, height: int, round_: int) -> bool:
+    """The amnesiac's precommit: commit to the current round's proposal
+    regardless of any earlier lock (and without requiring a polka)."""
+    rs = cs.rs
+    if rs.proposal_block is None:
+        cs._sign_add_vote(PRECOMMIT_TYPE, b"", PartSetHeader())
+    else:
+        cs._sign_add_vote(PRECOMMIT_TYPE, rs.proposal_block.hash(),
+                          rs.proposal_block_parts.header())
+    return True
+
+
+def equivocating_proposer(switch):
+    """Propose-slot hook: when this node is the proposer, sign TWO
+    conflicting proposals for the same H/R (same txs, nudged header time →
+    different block hash) and push each proposal with its FULL part set to
+    a disjoint half of the peers, splitting the honest prevote (reference:
+    the maverick's double-proposal misbehaviors). Internally the node
+    tracks variant A only."""
+
+    def hook(cs, height: int, round_: int) -> bool:
+        created = cs._create_proposal_block()
+        if created is None:
+            return True
+        block_a, parts_a = created
+        block_a.hash()  # fills the derived header hashes before the copy
+        header_b = dataclasses.replace(
+            block_a.header, time=block_a.header.time.add_ns(1_000_000))
+        block_b = dataclasses.replace(block_a, header=header_b)
+        parts_b = PartSet.from_data(block_b.marshal())
+
+        proposals = []
+        for block, parts in ((block_a, parts_a), (block_b, parts_b)):
+            bid = BlockID(hash=block.hash(), part_set_header=parts.header())
+            prop = Proposal(height=height, round=round_,
+                            pol_round=cs.rs.valid_round, block_id=bid,
+                            timestamp=Time.now())
+            try:
+                cs.priv_validator.sign_proposal(cs.state.chain_id, prop)
+            except Exception:  # noqa: BLE001 - a guarded signer refuses the
+                # second proposal; the equivocation simply degrades
+                return True
+            proposals.append((prop, parts))
+
+        # track variant A ourselves (normal internal self-delivery)
+        prop_a, _ = proposals[0]
+        cs._internal_queue.put(MsgInfo(ProposalMessage(prop_a), ""))
+        for i in range(parts_a.header().total):
+            cs._internal_queue.put(
+                MsgInfo(BlockPartMessage(height, round_, parts_a.get_part(i)), ""))
+
+        peers = _peers(switch)
+        halves = (peers[0::2], peers[1::2])
+        for (prop, parts), half in zip(proposals, halves):
+            for p in half:
+                p.try_send(DATA_CHANNEL, msg_proposal(prop))
+                for i in range(parts.header().total):
+                    p.try_send(DATA_CHANNEL,
+                               msg_block_part(height, round_, parts.get_part(i)))
+        return True
+
+    return hook
+
+
+# --- lunatic: fabricated headers staged for light clients --------------------
+
+
+def fabricate_light_block(node, height: int, claimed_power: int = 10):
+    """Forge the lunatic's conflicting light block for a committed height:
+    the real header with fabricated app/validators hashes under a claimed
+    one-member validator set the byzantine node fully controls, and a
+    commit carrying the node's own (real, attributable) signature — the
+    posterior-corruption artifact a light client whose trusted common
+    ancestor gave this key >= 1/3 power will accept from a byzantine
+    primary (docs/BYZANTINE.md cookbook; reference: types/evidence.go:219
+    ConflictingHeaderIsInvalid's lunatic taxonomy)."""
+    from tendermint_tpu.types.block import Commit, CommitSig
+    from tendermint_tpu.types.light_block import LightBlock, SignedHeader
+    from tendermint_tpu.types.validator import Validator
+    from tendermint_tpu.types.validator_set import ValidatorSet
+    from tendermint_tpu.types.vote import BLOCK_ID_FLAG_COMMIT, Vote
+
+    meta = node.block_store.load_block_meta(height)
+    if meta is None:
+        return None
+    pub = node.priv_validator.get_pub_key()
+    claimed = ValidatorSet([Validator.new(pub, claimed_power)])
+    fake_header = dataclasses.replace(
+        meta.header,
+        app_hash=FABRICATED_APP_HASH,
+        validators_hash=claimed.hash(),
+        next_validators_hash=claimed.hash(),
+    )
+    bid = BlockID(hash=fake_header.hash(),
+                  part_set_header=PartSet.from_data(fake_header.marshal()).header())
+    vote = Vote(type=PRECOMMIT_TYPE, height=height, round=0, block_id=bid,
+                timestamp=fake_header.time.add_ns(1_000_000),
+                validator_address=pub.address(), validator_index=0)
+    node.priv_validator.sign_vote(node.genesis.chain_id, vote)
+    commit = Commit(height=height, round=0, block_id=bid,
+                    signatures=[CommitSig(BLOCK_ID_FLAG_COMMIT, pub.address(),
+                                          vote.timestamp, vote.signature)])
+    return LightBlock(signed_header=SignedHeader(fake_header, commit),
+                      validator_set=claimed)
+
+
+def lunatic_proposer(node, lo: int = 0, hi: int = 0):
+    """Install the lunatic on ``node``: returns the propose-slot hook
+    (fabricated-app-hash proposals honest validators reject) and wires the
+    light-client attack staging — every committed height inside
+    [lo, hi] (0 = open) gets a fabricated conflicting light block
+    registered in ``node.byzantine_light_blocks``, which the node's
+    ``light_block`` RPC route serves INSTEAD of the honest block (the
+    byzantine-primary seam the live attack scenario drives)."""
+    fakes = getattr(node, "byzantine_light_blocks", None)
+    if fakes is None:
+        fakes = node.byzantine_light_blocks = {}
+
+    def in_window(h: int) -> bool:
+        return h >= 1 and (lo <= 0 or h >= lo) and (hi <= 0 or h <= hi)
+
+    def fabricate(h: int) -> None:
+        if h in fakes or not in_window(h):
+            return
+        try:
+            lb = fabricate_light_block(node, h)
+        except Exception:  # noqa: BLE001 - fabrication must never crash the
+            # consensus thread it piggybacks on (fail to lie, stay live)
+            lb = None
+        if lb is not None:
+            fakes[h] = lb
+
+    # posterior corruption: heights already committed when the node turns
+    # byzantine are forged immediately (the key signed them honestly once;
+    # now it signs a conflicting history for them)
+    for h in range(max(node.block_store.base, 1), node.block_store.height + 1):
+        fabricate(h)
+
+    def on_step(rs) -> None:
+        fabricate(rs.height - 1)
+
+    node.consensus.on_new_round_step.append(on_step)
+    # registered so a later install() (behavior cycling) can unhook the
+    # fabricator: a node cycled away from lunatic must STOP forging
+    if not hasattr(node, "_byz_on_step"):
+        node._byz_on_step = []
+    node._byz_on_step.append(on_step)
+
+    def hook(cs, height: int, round_: int) -> bool:
+        created = cs._create_proposal_block()
+        if created is None:
+            return True
+        block, _ = created
+        block.hash()
+        lunatic_header = dataclasses.replace(block.header,
+                                             app_hash=FABRICATED_APP_HASH)
+        lunatic_block = dataclasses.replace(block, header=lunatic_header)
+        parts = PartSet.from_data(lunatic_block.marshal())
+        bid = BlockID(hash=lunatic_block.hash(), part_set_header=parts.header())
+        prop = Proposal(height=height, round=round_, pol_round=cs.rs.valid_round,
+                        block_id=bid, timestamp=Time.now())
+        try:
+            cs.priv_validator.sign_proposal(cs.state.chain_id, prop)
+        except Exception:  # noqa: BLE001 - guarded signer: skip proposing
+            return True
+        msgs = [MsgInfo(ProposalMessage(prop), "")]
+        for i in range(parts.header().total):
+            msgs.append(MsgInfo(BlockPartMessage(height, round_,
+                                                 parts.get_part(i)), ""))
+        for m in msgs:
+            cs._internal_queue.put(m)
+            if cs.broadcast is not None:
+                cs.broadcast(m.msg)
+        return True
+
+    return hook
+
+
+# --- per-height behavior maps (spec grammar + installer) ---------------------
+
+# behavior name -> (slots it occupies, factory(node, lo, hi) -> hook)
+_SLOT_PREVOTE = "prevote"
+_SLOT_PRECOMMIT = "precommit"
+_SLOT_PROPOSE = "propose"
+
+BEHAVIORS = ("double_prevote", "double_precommit", "amnesia", "equivocate",
+             "lunatic", "absent", "absent_prevote")
+
+
+@dataclass(frozen=True)
+class BehaviorWindow:
+    """One ``<behavior>[~<lo>[-<hi>]]`` segment; lo/hi of 0 mean open."""
+
+    behavior: str
+    lo: int = 0
+    hi: int = 0
+
+    def active(self, height: int) -> bool:
+        return ((self.lo <= 0 or height >= self.lo)
+                and (self.hi <= 0 or height <= self.hi))
+
+    def describe(self) -> str:
+        if self.lo <= 0 and self.hi <= 0:
+            return self.behavior
+        if self.lo == self.hi:
+            return f"{self.behavior}~{self.lo}"
+        return (f"{self.behavior}~{self.lo if self.lo > 0 else ''}"
+                f"-{self.hi if self.hi > 0 else ''}")
+
+
+def parse_spec(spec: str) -> list[BehaviorWindow]:
+    """``"equivocate~3-5+lunatic~7-"`` -> behavior windows. A bare height
+    (``~4``) pins one height; an open bound (``~3-``) runs to the end."""
+    out = []
+    for seg in spec.split("+"):
+        seg = seg.strip()
+        if not seg:
+            continue
+        name, _, hrange = seg.partition("~")
+        if name not in BEHAVIORS:
+            raise ValueError(f"unknown byzantine behavior {name!r} "
+                             f"(want one of {', '.join(BEHAVIORS)})")
+        lo = hi = 0
+        if hrange:
+            lo_s, dash, hi_s = hrange.partition("-")
+            lo = int(lo_s) if lo_s else 0
+            # bare `~h` pins one height; `~lo-` leaves the end open
+            hi = int(hi_s) if hi_s else (lo if not dash else 0)
+        out.append(BehaviorWindow(name, lo, hi))
+    if not out:
+        raise ValueError(f"empty byzantine spec {spec!r}")
+    return out
+
+
+def describe_spec(windows: list[BehaviorWindow]) -> str:
+    return "+".join(w.describe() for w in windows)
+
+
+def _hooks_for(node, w: BehaviorWindow) -> dict:
+    """Slot -> hook for one window (hooks constructed once at install)."""
+    sw = node.switch
+    if w.behavior == "double_prevote":
+        return {_SLOT_PREVOTE: double_prevote(sw)}
+    if w.behavior == "double_precommit":
+        return {_SLOT_PRECOMMIT: double_precommit(sw)}
+    if w.behavior == "amnesia":
+        return {_SLOT_PREVOTE: amnesia_prevote,
+                _SLOT_PRECOMMIT: amnesia_precommit}
+    if w.behavior == "equivocate":
+        return {_SLOT_PROPOSE: equivocating_proposer(sw)}
+    if w.behavior == "lunatic":
+        return {_SLOT_PROPOSE: lunatic_proposer(node, w.lo, w.hi)}
+    # absent / absent_prevote
+    return {_SLOT_PREVOTE: absent_prevote}
+
+
+def install(node, spec: str) -> list[BehaviorWindow]:
+    """Make ``node`` byzantine per ``spec`` (maverick mode). Swaps a
+    double-sign-guarded FilePV for an unguarded MockPV with the SAME key —
+    a byzantine actor ignores its own safety guard — then wires per-slot
+    dispatchers that consult the height windows, falling through to the
+    honest default outside them. Installing again REPLACES the previous
+    behavior map (the soak's ``byz`` action cycles behaviors this way)."""
+    from tendermint_tpu.privval.file_pv import FilePV, MockPV
+
+    windows = parse_spec(spec)
+    if isinstance(node.priv_validator, FilePV):
+        unguarded = MockPV(node.priv_validator.priv_key)
+        node.priv_validator = unguarded
+        node.consensus.priv_validator = unguarded
+        node.consensus.priv_validator_pub_key = unguarded.get_pub_key()
+
+    # unhook the previous map's side channels (the lunatic's light-block
+    # fabricator rides on_new_round_step): replace means replace
+    for cb in getattr(node, "_byz_on_step", ()):
+        try:
+            node.consensus.on_new_round_step.remove(cb)
+        except ValueError:
+            pass
+    node._byz_on_step = []
+
+    by_slot: dict[str, list] = {}
+    for w in windows:
+        for slot, hook in _hooks_for(node, w).items():
+            by_slot.setdefault(slot, []).append((w, hook))
+
+    def dispatcher(entries):
+        def dispatch(cs, height: int, round_: int):
+            for w, hook in entries:
+                if w.active(height):
+                    return hook(cs, height, round_)
+            return False  # honest default outside every window
+
+        return dispatch
+
+    # replace, don't merge: a behavior-cycling schedule installs each new
+    # map over the last (stale slots from the previous map must not linger)
+    for slot in (_SLOT_PREVOTE, _SLOT_PRECOMMIT, _SLOT_PROPOSE):
+        node.consensus.misbehaviors.pop(slot, None)
+    for slot, entries in by_slot.items():
+        node.consensus.misbehaviors[slot] = dispatcher(entries)
+    return windows
+
+
+__all__ = [
+    "BEHAVIORS",
+    "BehaviorWindow",
+    "absent_prevote",
+    "amnesia_precommit",
+    "amnesia_prevote",
+    "describe_spec",
+    "double_precommit",
+    "double_prevote",
+    "equivocating_proposer",
+    "fabricate_light_block",
+    "install",
+    "lunatic_proposer",
+    "parse_spec",
+]

@@ -214,9 +214,13 @@ class ConsensusState:
         # (standalone/replay machines).
         self.scoreboard = None
         # Maverick-style misbehavior hooks for adversarial testing
-        # (reference: test/maverick/consensus/misbehavior.go:16). Key
-        # "prevote" -> fn(cs, height, round) replaces the default prevote
-        # behavior. Production nodes never set this.
+        # (reference: test/maverick/consensus/misbehavior.go:16;
+        # consensus/misbehavior.py is the behavior catalog). Keys
+        # "prevote" / "precommit" / "propose" -> fn(cs, height, round);
+        # a truthy return means the hook HANDLED the action (the default
+        # behavior is skipped), falsy falls through to the honest default
+        # so height-windowed behavior maps can play honest outside their
+        # window. Production nodes never set this.
         self.misbehaviors: dict = {}
         # decided-block callback fans (reactor hooks; reference evsw usage)
         self.on_new_round_step = []  # callbacks(rs)
@@ -909,6 +913,9 @@ class ConsensusState:
 
     def _decide_proposal(self, height: int, round_: int) -> None:
         """reference: consensus/state.go:1124-1180 defaultDecideProposal."""
+        mb = self.misbehaviors.get("propose")
+        if mb is not None and mb(self, height, round_):
+            return
         rs = self.rs
         if rs.valid_block is not None:
             block, block_parts = rs.valid_block, rs.valid_block_parts
@@ -983,8 +990,7 @@ class ConsensusState:
     def _do_prevote(self, height: int, round_: int) -> None:
         """reference: consensus/state.go:1252-1284 defaultDoPrevote."""
         mb = self.misbehaviors.get("prevote")
-        if mb is not None:
-            mb(self, height, round_)
+        if mb is not None and mb(self, height, round_):
             return
         rs = self.rs
         if rs.locked_block is not None:
@@ -1030,6 +1036,11 @@ class ConsensusState:
             rs.round = round_
             rs.step = STEP_PRECOMMIT
             self._new_step()
+
+        mb = self.misbehaviors.get("precommit")
+        if mb is not None and mb(self, height, round_):
+            done()
+            return
 
         block_id, ok = rs.votes.prevotes(round_).two_thirds_majority()
         if not ok:

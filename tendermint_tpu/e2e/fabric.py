@@ -242,13 +242,27 @@ class Cluster:
                  chain_id: str = "fabric-chain", mempool_broadcast: bool = True,
                  durable: bool = False, snapshot_interval: int = 0,
                  rpc_node: int = -1, metrics_node: int = -1, tweak=None,
-                 trace: bool = False, logger=None):
+                 trace: bool = False, powers: list[int] | None = None,
+                 rpc_nodes: tuple = (), byzantine: dict | None = None,
+                 logger=None):
         self.root = str(root)
         self.n_initial = n
         self.topology = topology
         self.n_validators = n if n_validators is None else n_validators
         self.power = power
+        # per-validator genesis powers (index-aligned, overrides the
+        # uniform `power`): the byzantine attack cookbook needs uneven
+        # trajectories — e.g. a posterior-corruption lunatic that HELD
+        # >= 1/3 at an old height but holds < 1/3 live (docs/BYZANTINE.md)
+        self.powers = list(powers) if powers is not None else None
+        # byzantine is a first-class fabric role: {idx: behavior spec}
+        # (consensus/misbehavior.py grammar), installed at start() behind
+        # a strict < 1/3 aggregate-power guard; self.byzantine tracks the
+        # role for the auditors (honest-prefix fork audit, quorum math)
+        self._byzantine_specs = dict(byzantine) if byzantine else {}
+        self.byzantine: set[int] = set()
         self.chain_id = chain_id
+        self.rpc_nodes = tuple(rpc_nodes)
         self.mempool_broadcast = mempool_broadcast
         self.durable = durable
         self.snapshot_interval = snapshot_interval
@@ -277,11 +291,17 @@ class Cluster:
 
         self._privs = [ed25519.gen_priv_key(_priv_seed(0x11, i))
                        for i in range(self.n_initial)]
+
+        def power_of(i: int) -> int:
+            if self.powers is not None and i < len(self.powers):
+                return self.powers[i]
+            return self.power
+
         self._genesis = GenesisDoc(
             chain_id=self.chain_id,
             genesis_time=Time(1700009000, 0),
-            validators=[GenesisValidator(b"", p.pub_key(), self.power)
-                        for p in self._privs[:self.n_validators]],
+            validators=[GenesisValidator(b"", p.pub_key(), power_of(i))
+                        for i, p in enumerate(self._privs[:self.n_validators])],
         )
 
     def _mk_config(self, idx: int):
@@ -302,6 +322,11 @@ class Cluster:
         if idx == self.rpc_node:
             cfg.rpc.laddr = "tcp://127.0.0.1:0"
             cfg.rpc.unsafe = True
+        elif idx in self.rpc_nodes:
+            # extra RPC listeners (no unsafe routes): the live light-client
+            # attack scenario points an out-of-process client at a
+            # byzantine primary AND an honest witness over real RPC
+            cfg.rpc.laddr = "tcp://127.0.0.1:0"
         if idx == self.metrics_node:
             cfg.instrumentation.prometheus = True
             cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
@@ -360,6 +385,8 @@ class Cluster:
         self._next_idx = self.n_initial
         for i, j in topology_edges(self.topology, self.n_initial):
             self.link(i, j)
+        for idx, spec in sorted(self._byzantine_specs.items()):
+            self.install_byzantine(idx, spec)
 
     def stop(self) -> None:
         for fn in list(self.nodes.values()):
@@ -416,6 +443,15 @@ class Cluster:
     def node_id(self, i: int) -> str:
         return self.nodes[i].id
 
+    def rpc_url(self, i: int) -> str:
+        """http base URL of a node's RPC listener (needs rpc_node or
+        rpc_nodes to have included ``i`` at construction)."""
+        rpc = self.nodes[i].node.rpc_server
+        if rpc is None:
+            raise RuntimeError(f"node {i} has no RPC listener "
+                               "(pass rpc_node/rpc_nodes)")
+        return "http://" + rpc.laddr.split("://", 1)[1]
+
     def partition(self, groups: list[list[int]]) -> None:
         nemesis.partition([[self.node_id(i) for i in g if i in self.nodes]
                            for g in groups])
@@ -470,14 +506,21 @@ class Cluster:
             return None
         return None if meta is None else meta.block_id.hash
 
-    def audit_agreement(self, min_height: int = 1) -> int:
-        """Full-prefix fork audit: every committed height on every node
-        must carry one block hash cluster-wide. Returns heights audited;
-        raises AssertionError with the per-node map on any fork."""
+    def audit_agreement(self, min_height: int = 1,
+                        include_byzantine: bool = False) -> int:
+        """Full-prefix fork audit: every committed height on every HONEST
+        node must carry one block hash cluster-wide (safety under
+        byzantium is a promise about the honest prefix; a byzantine node's
+        store is its own problem — pass include_byzantine=True to audit it
+        anyway). Returns heights audited; raises AssertionError with the
+        per-node map on any fork."""
         audited = 0
+        skip = set() if include_byzantine else self.byzantine
         for h in range(min_height, self.max_height() + 1):
             hashes = {}
             for i in sorted(self.nodes):
+                if i in skip:
+                    continue
                 bh = self.block_hash(i, h)
                 if bh is not None:
                     hashes[i] = bh
@@ -597,15 +640,40 @@ class Cluster:
         return {i: by_pub.get(fn.priv.pub_key().bytes(), 0)
                 for i, fn in self.nodes.items()}
 
-    def install_misbehavior(self, idx: int, name: str = "double_prevote") -> None:
+    def byzantine_power_fraction(self, extra: set[int] | None = None) -> tuple[int, int]:
+        """(byzantine power, total power) of the CURRENT validator set,
+        counting ``extra`` indices as if already byzantine — the < 1/3
+        guard every byzantine install runs behind."""
+        powers = self.validator_powers()
+        byz = self.byzantine | (extra or set())
+        total = sum(max(p, 0) for p in powers.values())
+        byz_power = sum(max(powers.get(i, 0), 0) for i in byz)
+        return byz_power, total
+
+    def install_byzantine(self, idx: int, spec: str = "double_prevote",
+                          enforce_power: bool = True) -> None:
+        """Make a live node byzantine per a consensus/misbehavior.py spec
+        (``"equivocate~3-5+lunatic~7-"``, docs/BYZANTINE.md). The default
+        guard refuses an install that would push aggregate byzantine power
+        to >= 1/3 of the current set — the fabric's byzantine role exists
+        to prove safety BELOW the BFT bound, not to fork the cluster;
+        attack cookbooks that stage historic >= 1/3 coalitions do it
+        through power churn, not by disabling the guard."""
         from tendermint_tpu.consensus import misbehavior as mb
 
-        node = self.nodes[idx].node
-        hooks = {
-            "double_prevote": lambda: mb.double_prevote(node.switch),
-            "absent_prevote": lambda: mb.absent_prevote,
-        }
-        node.consensus.misbehaviors["prevote"] = hooks[name]()
+        if enforce_power:
+            byz_power, total = self.byzantine_power_fraction({idx})
+            if total > 0 and 3 * byz_power >= total:
+                raise ValueError(
+                    f"byzantine install on node {idx} would put "
+                    f"{byz_power}/{total} voting power under adversary "
+                    f"control (>= 1/3); refuse (docs/BYZANTINE.md)")
+        mb.install(self.nodes[idx].node, spec)
+        self.byzantine.add(idx)
+
+    def install_misbehavior(self, idx: int, name: str = "double_prevote") -> None:
+        """Back-compat shim for the soak ``evidence`` action."""
+        self.install_byzantine(idx, name)
 
     # --- load ---------------------------------------------------------------
 
@@ -636,8 +704,9 @@ class Cluster:
         peer_sides = sum(len(fn.links) for fn in self.nodes.values())
         per_node = NODE_BASE_THREADS + (1 if self.mempool_broadcast else 0) + (
             NODE_THREADS_INGEST if _ingest.enabled() else 0)
-        extra = (1 if self.metrics_node >= 0 else 0) + (
-            2 if self.rpc_node >= 0 else 0)
+        rpc_listeners = (1 if self.rpc_node >= 0 else 0) + len(
+            [i for i in self.rpc_nodes if i != self.rpc_node])
+        extra = (1 if self.metrics_node >= 0 else 0) + 2 * rpc_listeners
         return len(self.nodes) * per_node + peer_sides * per_peer + extra
 
     def expected_fd_budget(self) -> int:

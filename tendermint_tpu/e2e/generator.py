@@ -20,6 +20,15 @@ from tendermint_tpu.e2e.runner import Manifest, Perturbation, PowerChange
 _VALIDATORS = (2, 3, 4, 5)
 _FASTSYNC = ("v0", "v0", "v1", "v2")  # v0 weighted: the default path
 _PERTURB_ACTIONS = ("kill", "restart", "pause", "partition")
+# Byzantine behavior dimension (docs/BYZANTINE.md): derived from the
+# authoritative consensus/misbehavior.py catalog (minus the `absent`
+# alias) so a behavior added there enters the nightly matrix
+# automatically; double_prevote double-weighted — it is the one that
+# provokes the committed DuplicateVoteEvidence runner assertions key on.
+from tendermint_tpu.consensus.misbehavior import BEHAVIORS as _MB_BEHAVIORS
+
+_BYZ_BEHAVIORS = ("double_prevote",) + tuple(
+    b for b in _MB_BEHAVIORS if b != "absent")
 
 
 def generate_one(rng: random.Random, index: int = 0) -> Manifest:
@@ -55,10 +64,13 @@ def generate_one(rng: random.Random, index: int = 0) -> Manifest:
             at_height=rng.randrange(3, max(4, target - 2)),
         ))
     # A byzantine node needs >= 4 validators (1 byzantine < 1/3 of 4);
-    # roll it on a third of the big topologies.
+    # roll it on a third of the big topologies, cycling the behavior
+    # dimension so the nightly matrix walks the whole maverick catalog.
     byz = -1
+    misbehavior = "double_prevote"
     if n_vals >= 4 and rng.random() < 0.33:
         byz = rng.randrange(n_vals)
+        misbehavior = rng.choice(_BYZ_BEHAVIORS)
     return Manifest(
         validators=n_vals,
         chain_id=f"gen-{index}",
@@ -67,6 +79,7 @@ def generate_one(rng: random.Random, index: int = 0) -> Manifest:
         perturbations=perts,
         power_changes=powers,
         byzantine_node=byz,
+        misbehavior=misbehavior,
         fastsync_version=rng.choice(_FASTSYNC),
         statesync_joiner=n_vals >= 3 and rng.random() < 0.25,
     )

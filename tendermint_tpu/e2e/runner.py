@@ -132,8 +132,10 @@ class Manifest:
     power_changes: list[PowerChange] = field(default_factory=list)
     # Node index to run byzantine (reference: maverick nodes in e2e
     # manifests, pkg/manifest.go Misbehaviors), -1 = none. The byzantine
-    # node equivocates from the given height via TMTPU_MISBEHAVIOR; honest
-    # >2/3 must keep committing and produce DuplicateVoteEvidence.
+    # node runs `misbehavior` — any consensus/misbehavior.py behavior spec
+    # (docs/BYZANTINE.md), rolled by the generator's behavior dimension —
+    # via TMTPU_MISBEHAVIOR; honest >2/3 must keep committing (and, for
+    # the double-vote behaviors, produce DuplicateVoteEvidence).
     byzantine_node: int = -1
     misbehavior: str = "double_prevote"
     # Fast-sync version for all nodes (reference: manifest fast_sync key).

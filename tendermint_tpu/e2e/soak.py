@@ -41,6 +41,26 @@ seeded link decisions).
     @24:evidence:3               make node 3 equivocate (double_prevote)
     @27:bitrot:2:block           flip a seeded bit in node 2's block store
     @28:bitrot:2:state:truncate  truncate a state-history row at rest
+    @30:byz:5:double_precommit   full byzantine role: behavior spec on a node
+    @33:byz:5:equivocate~8-12    height-windowed behavior map (misbehavior.py
+                                 grammar; '+'-joined segments map behaviors)
+
+The ``byz`` action (and the legacy ``evidence`` shorthand) installs a
+consensus/misbehavior.py behavior spec on a node (docs/BYZANTINE.md) and
+marks it byzantine for the auditors. Both are guarded: an install that
+would push aggregate byzantine power to >= 1/3 of the current set is
+SKIPPED (the soak proves safety below the BFT bound, it does not fork
+itself), and byzantine nodes count as non-voting in the driver's quorum
+arithmetic (their participation is adversary-controlled, so a partition
+that leaves the honest side short of 2/3 is an expected stall).
+
+The :class:`ContinuousAuditor` additionally audits the EVIDENCE LIFECYCLE
+under byzantium: every piece of evidence committed by any honest node must
+be committed by EVERY honest node exactly once within
+``TMTPU_BYZ_EVIDENCE_BOUND`` heights of its first commit (a provoked
+misbehavior that converges on some nodes but not others, or lands twice,
+is a violation — flight-recorder-annotated like a liveness stall), and the
+block-hash agreement audit covers the HONEST prefix only.
 
 The driver tracks quorum arithmetic: while an installed partition leaves no
 side with >2/3 of the voting power, the auditor is told a stall is EXPECTED
@@ -56,6 +76,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from tendermint_tpu.consensus import misbehavior
 from tendermint_tpu.e2e.fabric import Cluster
 from tendermint_tpu.utils import faults, nemesis
 
@@ -64,7 +85,13 @@ DEFAULT_DURATION_S = 20.0
 DEFAULT_TOPOLOGY = "k-regular:4"
 
 _KINDS = ("partition", "linkfault", "flood", "join", "join_statesync",
-          "power", "restart", "leave", "evidence", "bitrot")
+          "power", "restart", "leave", "evidence", "bitrot", "byz")
+
+# the behaviors a seeded schedule cycles byzantine nodes through: derived
+# from the authoritative catalog (a behavior added there is exercised by
+# generated soaks automatically), minus the absent_prevote alias
+_BYZ_BEHAVIORS = tuple(b for b in misbehavior.BEHAVIORS
+                       if b != "absent_prevote")
 
 
 @dataclass
@@ -126,12 +153,18 @@ class SoakSchedule:
         rng = random.Random(f"soak:{seed}:{nodes}:{duration_s:g}")
         actions: list[SoakAction] = []
         joined = 0
+        # byzantine bookkeeping: the generator never schedules an
+        # aggregate adversary of >= 1/3 (equal genesis powers, so the
+        # bound is a node count: 3 * (byz + 1) < nodes); behaviors cycle
+        # deterministically so a long soak walks the whole catalog
+        byz_nodes: list[int] = []
+        byz_cycle = 0
         # one perturbation every ~duration/7, starting after a warm-up
         slots = max(3, int(duration_s / max(duration_s / 7.0, 2.0)))
         step = duration_s * 0.7 / slots
         t = duration_s * 0.15
         kinds = ["partition", "linkfault", "join", "power", "flood",
-                 "restart", "evidence", "bitrot"]
+                 "restart", "evidence", "bitrot", "byz"]
         if statesync_ok:
             kinds.append("join_statesync")
         for _ in range(slots):
@@ -163,9 +196,26 @@ class SoakSchedule:
             elif kind == "restart":
                 actions.append(SoakAction(round(t, 1), kind,
                                           str(rng.randrange(nodes))))
-            elif kind == "evidence":
-                actions.append(SoakAction(round(t, 1), kind,
-                                          str(rng.randrange(nodes))))
+            elif kind in ("evidence", "byz"):
+                # both kinds grow the adversary: share the < 1/3 ledger.
+                # Re-target an existing byzantine node (cycling its
+                # behavior) when growing the coalition would cross 1/3.
+                if byz_nodes and (rng.random() < 0.5
+                                  or 3 * (len(byz_nodes) + 1) >= nodes):
+                    target = rng.choice(byz_nodes)
+                elif 3 * (len(byz_nodes) + 1) < nodes:
+                    target = rng.choice([i for i in range(nodes)
+                                         if i not in byz_nodes])
+                    byz_nodes.append(target)
+                else:
+                    continue  # a 3-node net has no room for an adversary
+                if kind == "evidence":
+                    actions.append(SoakAction(round(t, 1), kind, str(target)))
+                else:
+                    behavior = _BYZ_BEHAVIORS[byz_cycle % len(_BYZ_BEHAVIORS)]
+                    byz_cycle += 1
+                    actions.append(SoakAction(round(t, 1), kind,
+                                              f"{target}:{behavior}"))
             elif kind == "bitrot":
                 # at-rest corruption of one node's storage plane: the
                 # scrubber must detect it and the repairer heal it with
@@ -183,12 +233,15 @@ class SoakSchedule:
 
 @dataclass
 class Violation:
-    kind: str      # "fork" | "liveness" | "audit"
+    kind: str      # "fork" | "liveness" | "audit" | "evidence"
     detail: str
     at_s: float = 0.0
 
     def __str__(self) -> str:
         return f"[{self.kind} @{self.at_s:.1f}s] {self.detail}"
+
+
+DEFAULT_EVIDENCE_BOUND = 8  # heights; TMTPU_BYZ_EVIDENCE_BOUND overrides
 
 
 class ContinuousAuditor:
@@ -203,18 +256,41 @@ class ContinuousAuditor:
     Liveness: the max committed height must advance within
     ``liveness_budget_s`` whenever the driver hasn't declared a stall
     expected (a quorum-cutting partition window + heal grace).
+
+    Evidence lifecycle (docs/BYZANTINE.md): every piece of evidence any
+    honest node commits must be committed by EVERY honest node exactly
+    once within ``evidence_bound`` heights of its first commit. Exactly-
+    once catches a pool that re-admits committed evidence; the convergence
+    bound catches evidence that verified on some honest nodes but not
+    others (a determinism bug in verification — the one detection
+    machinery divergence a fork audit can't see). Both safety sweeps skip
+    byzantine nodes: the promises are about the honest prefix.
     """
 
     def __init__(self, cluster: Cluster, liveness_budget_s: float = 30.0,
-                 poll_s: float = 0.3, logger=None):
+                 poll_s: float = 0.3, evidence_bound: int | None = None,
+                 logger=None):
         self.cluster = cluster
         self.liveness_budget_s = liveness_budget_s
         self.poll_s = poll_s
         self.logger = logger
         self.violations: list[Violation] = []
         self.heights_audited = 0
+        self.evidence_audited = 0   # distinct committed evidence tracked
+        self.evidence_bound = (evidence_bound if evidence_bound is not None
+                               else int(os.environ.get(
+                                   "TMTPU_BYZ_EVIDENCE_BOUND",
+                                   DEFAULT_EVIDENCE_BOUND)))
         self._agreed: dict[int, bytes] = {}
         self._checked: dict[int, tuple[int, int]] = {}  # idx -> (node id(), h)
+        # evidence lifecycle books: hash -> {idx: [commit heights]},
+        # hash -> first commit height, plus flags so each anomaly reports
+        # exactly once per (evidence, node)
+        self._ev_seen: dict[bytes, dict[int, list[int]]] = {}
+        self._ev_first: dict[bytes, int] = {}
+        self._ev_scanned: dict[int, tuple] = {}  # idx -> (gen key, height)
+        self._ev_flagged: set = set()            # (hash, idx) pairs reported
+        self._ev_converged: set = set()
         self._t0 = 0.0
         self._last_advance = 0.0
         self._best = 0
@@ -290,9 +366,16 @@ class ContinuousAuditor:
     def sweep(self) -> None:
         """One audit pass (public so tests and the final drain call it
         synchronously)."""
+        byz = getattr(self.cluster, "byzantine", set())
         nodes = sorted(self.cluster.nodes.items())
         best = self._best
         for idx, fn in nodes:
+            if idx in byz:
+                # safety-under-byzantium is a promise about the HONEST
+                # prefix; a byzantine node's store stays off the agreement
+                # ledger (its tip still feeds the liveness clock below)
+                best = max(best, fn.height)
+                continue
             # FabricNode carries a process-monotonic generation; id() alone
             # can be REUSED by the allocator after the old Node is
             # collected, which would silently skip a restarted node's
@@ -328,6 +411,7 @@ class ContinuousAuditor:
                                  f"{agreed.hex()[:16]}")
             self._checked[idx] = (key, checked_to)
             best = max(best, tip)
+        self._sweep_evidence(byz)
         now = time.monotonic()
         if best > self._best:
             self._best = best
@@ -345,6 +429,87 @@ class ContinuousAuditor:
                          f"height {self._best}"
                          + (f" [lagging: {lag}]" if lag else ""))
 
+    # --- evidence-lifecycle convergence (docs/BYZANTINE.md) -----------------
+
+    def _sweep_evidence(self, byz: set) -> None:
+        """Incrementally scan each honest node's newly committed blocks for
+        evidence, then check the exactly-once + bounded-convergence
+        invariants. Incremental like the fork sweep: each (node, height)
+        block is read once per node generation."""
+        honest = {i: fn for i, fn in sorted(self.cluster.nodes.items())
+                  if i not in byz}
+        for idx, fn in honest.items():
+            key = (getattr(fn, "generation", None), id(fn.node))
+            prev_key, prev_h = self._ev_scanned.get(idx, (key, 0))
+            start_h = prev_h + 1 if prev_key == key else 1
+            store = getattr(fn.node, "block_store", None)
+            start_h = max(start_h, getattr(store, "base", 1) or 1)
+            scanned_to = start_h - 1
+            for h in range(start_h, fn.height + 1):
+                try:
+                    block = store.load_block(h)
+                except Exception:  # noqa: BLE001 - quarantined/rotten row:
+                    block = None   # re-read next sweep like the fork audit
+                if block is None:
+                    break  # mid-persist tip: stop, retry next sweep
+                scanned_to = h
+                for ev in block.evidence:
+                    evh = ev.hash()
+                    rec = self._ev_seen.setdefault(evh, {})
+                    if evh not in self._ev_first:
+                        self._ev_first[evh] = h
+                        self.evidence_audited += 1
+                    heights = rec.setdefault(idx, [])
+                    if h not in heights:
+                        # dedup by height: a restarted node's full-prefix
+                        # rescan re-reads the SAME carrying block — only a
+                        # commit at a second height is a real re-admission
+                        # (one block can't carry the same evidence twice;
+                        # check_evidence dedups in-block)
+                        heights.append(h)
+                    if len(rec[idx]) > 1 and (evh, idx) not in self._ev_flagged:
+                        self._ev_flagged.add((evh, idx))
+                        self._record(
+                            "evidence",
+                            f"evidence {evh.hex()[:16]} committed TWICE on "
+                            f"node {idx} (heights {rec[idx]}): the pool "
+                            f"re-admitted committed evidence")
+            self._ev_scanned[idx] = (key, scanned_to)
+        # convergence: once any honest node's scanned prefix is `bound`
+        # heights past an evidence's first commit, every honest node whose
+        # prefix also covers that window must carry it
+        for evh, first_h in self._ev_first.items():
+            if evh in self._ev_converged:
+                continue
+            rec = self._ev_seen.get(evh, {})
+            deadline = first_h + self.evidence_bound
+            overdue = []
+            missing = False
+            for idx, fn in honest.items():
+                if (getattr(fn.node.block_store, "base", 1) or 1) > first_h:
+                    continue  # statesync joiner: its pruned prefix
+                    # legitimately never contains the carrying block
+                if idx in rec:
+                    continue
+                missing = True
+                _, scanned_to = self._ev_scanned.get(idx, (None, 0))
+                if scanned_to >= deadline:
+                    overdue.append(idx)
+            if not missing:
+                self._ev_converged.add(evh)
+                continue
+            for idx in overdue:
+                if (evh, idx) in self._ev_flagged:
+                    continue
+                self._ev_flagged.add((evh, idx))
+                lag = self._lag_annotation()
+                self._record(
+                    "evidence",
+                    f"evidence {evh.hex()[:16]} (first committed at height "
+                    f"{first_h}) missing on node {idx} past the "
+                    f"{self.evidence_bound}-height convergence bound"
+                    + (f" [lagging: {lag}]" if lag else ""))
+
 
 # --- the driver --------------------------------------------------------------
 
@@ -358,6 +523,8 @@ class SoakReport:
     schedule: str
     heights: dict = field(default_factory=dict)
     heights_audited: int = 0
+    evidence_audited: int = 0
+    byzantine: list = field(default_factory=list)
     txs_submitted: int = 0
     actions_fired: int = 0
     violations: list = field(default_factory=list)
@@ -408,13 +575,29 @@ class SoakDriver:
     # --- quorum arithmetic: is a stall EXPECTED under this partition? -------
 
     def _quorum_cut(self, groups: list[list[int]]) -> bool:
-        powers = {i: max(p, 0)
+        # byzantine nodes count as NON-voting: their participation is
+        # adversary-controlled (absent, equivocating, ...), so any side
+        # that needs byzantine votes to reach 2/3 must be treated as
+        # quorum-less — a stall there is the safety property, not a bug
+        byz = getattr(self.cluster, "byzantine", set())
+        powers = {i: (0 if i in byz else max(p, 0))
                   for i, p in self.cluster.validator_powers().items()}
         total = sum(powers.values())
         if total <= 0:
             return False
         grouped = [sum(powers.get(i, 0) for i in g) for g in groups]
         return not any(3 * p > 2 * total for p in grouped)
+
+    def _byz_install_ok(self, idx: int) -> bool:
+        """The < 1/3 aggregate guard for scheduled byzantine installs: a
+        seeded schedule must never fork the cluster it audits."""
+        byz_power, total = self.cluster.byzantine_power_fraction({idx})
+        if total > 0 and 3 * byz_power >= total:
+            if self.logger:
+                self.logger.info("soak: skipping byzantine install",
+                                 node=idx, byz_power=byz_power, total=total)
+            return False
+        return True
 
     def _groups_from_arg(self, arg: str) -> list[list[int]]:
         """``4|rest`` or ``0/1|2/3`` -> index groups; ``rest`` expands to
@@ -480,8 +663,13 @@ class SoakDriver:
                 self.cluster.remove_node(idx)
         elif a.kind == "evidence":
             idx = int(a.arg)
-            if idx in self.cluster.nodes:
+            if idx in self.cluster.nodes and self._byz_install_ok(idx):
                 self.cluster.install_misbehavior(idx)
+        elif a.kind == "byz":
+            idx_s, _, spec = a.arg.partition(":")
+            idx = int(idx_s)
+            if idx in self.cluster.nodes and self._byz_install_ok(idx):
+                self.cluster.install_byzantine(idx, spec or "double_prevote")
         elif a.kind == "bitrot":
             parts = a.arg.split(":")
             idx = int(parts[0])
@@ -589,6 +777,8 @@ class SoakDriver:
             schedule=self.schedule.describe(),
             heights=self.cluster.heights(),
             heights_audited=self.auditor.heights_audited,
+            evidence_audited=self.auditor.evidence_audited,
+            byzantine=sorted(getattr(self.cluster, "byzantine", ())),
             txs_submitted=self.txs, actions_fired=self.fired,
             violations=[str(v) for v in self.auditor.violations],
         )

@@ -161,7 +161,7 @@ class EvidencePool:
                 and age_ns > ev_params.max_age_duration_ns):
             raise EvidenceError(
                 f"evidence from height {ev.height()} is too old; min height is "
-                f"{height - ev_params.max_age_num_blocks}"
+                f"{height - ev_params.max_age_num_blocks}", reason="expired"
             )
 
         if isinstance(ev, DuplicateVoteEvidence):
@@ -171,12 +171,13 @@ class EvidencePool:
             _, val = val_set.get_by_address(ev.vote_a.validator_address)
             if ev.validator_power != val.voting_power:
                 raise EvidenceError(
-                    f"evidence has validator power {ev.validator_power} but should be {val.voting_power}"
+                    f"evidence has validator power {ev.validator_power} but should be {val.voting_power}",
+                    reason="meta_mismatch",
                 )
             if ev.total_voting_power != val_set.total_voting_power():
                 raise EvidenceError(
                     f"evidence has total power {ev.total_voting_power} but should be "
-                    f"{val_set.total_voting_power()}"
+                    f"{val_set.total_voting_power()}", reason="meta_mismatch"
                 )
         elif isinstance(ev, LightClientAttackEvidence):
             self.verify_light_client_attack(ev, state)
@@ -185,11 +186,17 @@ class EvidencePool:
 
     @staticmethod
     def verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str, val_set) -> None:
-        """reference: evidence/verify.go:162-220."""
+        """reference: evidence/verify.go:162-220. The two vote signatures
+        dispatch as ONE BatchVerifier batch so evidence verification shares
+        the kernel/sigcache path like every other verify site (the serial
+        error order — vote A first — is replayed over the bitmap)."""
+        from tendermint_tpu.crypto import batch as crypto_batch
+
         _, val = val_set.get_by_address(ev.vote_a.validator_address)
         if val is None:
             raise EvidenceError(
-                f"address {ev.vote_a.validator_address.hex()} was not a validator at height {ev.height()}"
+                f"address {ev.vote_a.validator_address.hex()} was not a validator at height {ev.height()}",
+                reason="unknown_validator",
             )
         va, vb = ev.vote_a, ev.vote_b
         if va.height != vb.height or va.round != vb.round or va.type != vb.type:
@@ -201,10 +208,14 @@ class EvidencePool:
         if va.block_id.key() >= vb.block_id.key():
             raise EvidenceError("duplicate votes in invalid order")
         pub = val.pub_key
-        if not pub.verify_signature(va.sign_bytes(chain_id), va.signature):
-            raise EvidenceError("invalid signature on vote A")
-        if not pub.verify_signature(vb.sign_bytes(chain_id), vb.signature):
-            raise EvidenceError("invalid signature on vote B")
+        verifier = crypto_batch.create_batch_verifier()
+        verifier.add(pub, va.sign_bytes(chain_id), va.signature)
+        verifier.add(pub, vb.sign_bytes(chain_id), vb.signature)
+        _, bitmap = verifier.dispatch().resolve()
+        if not bitmap[0]:
+            raise EvidenceError("invalid signature on vote A", reason="bad_sig")
+        if not bitmap[1]:
+            raise EvidenceError("invalid signature on vote B", reason="bad_sig")
 
     def verify_light_client_attack(self, ev: LightClientAttackEvidence, state) -> None:
         """reference: evidence/verify.go:113-160 (batched commit verify via
@@ -234,10 +245,11 @@ class EvidencePool:
         if ev.total_voting_power != common_vals.total_voting_power():
             raise EvidenceError(
                 f"evidence total power {ev.total_voting_power} != "
-                f"{common_vals.total_voting_power()}")
+                f"{common_vals.total_voting_power()}", reason="meta_mismatch")
         common_meta = self.block_store.load_block_meta(ev.common_height)
         if common_meta is not None and ev.timestamp != common_meta.header.time:
-            raise EvidenceError("evidence timestamp != common block time")
+            raise EvidenceError("evidence timestamp != common block time",
+                                reason="meta_mismatch")
         trusted = self.block_store.load_block(sh.header.height)
         trusted_commit = (self.block_store.load_block_commit(sh.header.height)
                           or self.block_store.load_seen_commit(sh.header.height))
@@ -250,13 +262,14 @@ class EvidencePool:
             if len(derived) != len(carried):
                 raise EvidenceError(
                     f"expected {len(derived)} byzantine validators, "
-                    f"evidence names {len(carried)}")
+                    f"evidence names {len(carried)}", reason="meta_mismatch")
             for d, c in zip(derived, carried):
                 if d.address != c.address or d.voting_power != c.voting_power:
                     raise EvidenceError(
                         "byzantine validator mismatch: "
                         f"{d.address.hex()}/{d.voting_power} != "
-                        f"{c.address.hex()}/{c.voting_power}")
+                        f"{c.address.hex()}/{c.voting_power}",
+                        reason="meta_mismatch")
 
     # --- lifecycle hooks ---------------------------------------------------
 

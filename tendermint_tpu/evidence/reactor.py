@@ -1,6 +1,18 @@
 """Evidence reactor: gossips evidence to peers (reference:
 evidence/reactor.go, channel 0x38, proto/tendermint/evidence/types.proto
-EvidenceList)."""
+EvidenceList).
+
+Hardening (docs/BYZANTINE.md): a byzantine peer shipping syntactically
+valid but UNVERIFIABLE evidence — wrong chain-id or bogus signatures
+(bad_sig), expired age, metadata that contradicts our derivation — used to
+be silently dropped, an unmetered free shot at the verification CPU. Every
+rejection now lands in the pre-seeded ``evidence_rejected_total{reason}``
+counter and scores the delivering peer on the PeerScoreBoard
+(utils/peerscore.py ``evidence_reject``), so a flood of junk evidence
+walks the peer to disconnect/ban like any other protocol violation.
+Rejections that are OUR limitation — state we don't have yet
+(bootstrapping joiner), our own rotten store rows — stay unscored.
+"""
 
 from __future__ import annotations
 
@@ -24,6 +36,19 @@ def msg_evidence_list(evs: list) -> bytes:
     return w.out()
 
 
+def _count_rejected(reason: str) -> None:
+    """evidence_rejected_total{reason} — pre-seeded over the closed
+    EvidenceError.REASONS set in utils/metrics.py."""
+    try:
+        from tendermint_tpu.utils import metrics as tmmetrics
+
+        m = tmmetrics.GLOBAL_NODE_METRICS
+        if m is not None:
+            m.evidence_rejected.add(1, reason=reason)
+    except Exception:  # noqa: BLE001 - metrics never block gossip handling
+        pass
+
+
 class EvidenceReactor(Reactor):
     def __init__(self, pool: EvidencePool):
         super().__init__("EVIDENCE")
@@ -40,23 +65,40 @@ class EvidenceReactor(Reactor):
     def remove_peer(self, peer: Peer, reason) -> None:
         self._peer_running.pop(peer.id, None)
 
+    def _reject(self, peer: Peer, reason: str) -> None:
+        _count_rejected(reason)
+        board = getattr(self.switch, "scoreboard", None) if self.switch else None
+        if board is not None:
+            board.record(peer.id, "evidence_reject")
+
     def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
         from tendermint_tpu.state.store import StateStoreError
         from tendermint_tpu.store.envelope import CorruptedStoreError
+        from tendermint_tpu.types.validator_set import ValidatorSetError
 
         f = proto.fields(msg_bytes)
         for raw in f.get(1, []):
             try:
                 ev = evidence_unmarshal(raw)
+            except Exception:  # noqa: BLE001 - undecodable bytes on the
+                # evidence channel: peer violation, never a crash surface
+                self._reject(peer, "malformed")
+                continue
+            try:
                 self.pool.add_evidence(ev)
-            except EvidenceError:
-                pass
+            except EvidenceError as e:
+                self._reject(peer, getattr(e, "reason", "invalid"))
+            except ValidatorSetError:
+                # commit-verify failure inside verify_light_client_attack
+                # (bogus/insufficient signatures on the conflicting block)
+                self._reject(peer, "bad_sig")
             except CorruptedStoreError:
                 # verification tripped over OUR rotten state/block record —
                 # the store hook has quarantined + scheduled the repair;
                 # dropping the evidence (it regossips) instead of letting
                 # the error tear the peer down (thread-crash-surface rule,
-                # docs/DURABILITY.md)
+                # docs/DURABILITY.md). Our rot, not peer misbehavior:
+                # unscored.
                 pass
             except StateStoreError:
                 # Evidence for a height WE don't have state for yet — a

@@ -102,6 +102,10 @@ class Client:
         self.trusted_store = trusted_store
         self.pruning_size = pruning_size
         self.logger = logger
+        # substantiated attacks the detector proved (light/detector.py
+        # Divergence records): the live-attack harness reads the built
+        # evidence from here after ErrConflictingHeaders surfaces
+        self.divergences: list = []
         self.latest_trusted: LightBlock | None = trusted_store.latest_light_block()
         if self.latest_trusted is None:
             self._initialize(trust_options)

@@ -149,6 +149,12 @@ def _handle_conflicting_headers(client, conflict: ErrConflictingHeaders,
         common, witness_block, primary_block.signed_header)
     ev_against_primary = make_attack_evidence(
         common, primary_block, witness_block.signed_header)
+    # record the substantiated divergence on the client so callers (and
+    # the live-attack harness) can inspect/resubmit the evidence after the
+    # ErrConflictingHeaders surfaces
+    if hasattr(client, "divergences"):
+        client.divergences.append(Divergence(
+            conflict.witness_index, ev_against_primary, ev_against_witness))
     for ev, target in ((ev_against_witness, client.primary),
                        (ev_against_primary, witness)):
         if ev is None:

@@ -347,31 +347,22 @@ class Node:
             self.bc_reactor, self.handoff_to_fastsync,
             metrics=self.metrics, logger=logger)
 
-    def install_misbehavior(self, name: str) -> None:
+    def install_misbehavior(self, spec: str) -> None:
         """Maverick mode: make THIS node byzantine (reference:
         test/maverick/consensus/misbehavior.go, selected per node via the
-        maverick binary's --misbehaviors flag; here via the
-        TMTPU_MISBEHAVIOR env var so an e2e manifest can mark a real
+        maverick binary's --misbehaviors flag; here via the TMTPU_BYZ /
+        TMTPU_MISBEHAVIOR env vars so an e2e manifest can mark a real
         PROCESS byzantine).
 
-        Swaps the double-sign-guarded FilePV for an unguarded signer with
+        ``spec`` is a consensus/misbehavior.py behavior spec — a bare
+        behavior name (``double_prevote``) or a height-windowed map
+        (``equivocate~3-5+lunatic~7-``, docs/BYZANTINE.md). The installer
+        swaps a double-sign-guarded FilePV for an unguarded signer with
         the SAME key (a byzantine actor ignores its own safety guard) and
-        installs the consensus hook."""
+        wires the per-slot consensus hooks."""
         from tendermint_tpu.consensus import misbehavior as mb
-        from tendermint_tpu.privval.file_pv import FilePV, MockPV
 
-        if isinstance(self.priv_validator, FilePV):
-            unguarded = MockPV(self.priv_validator.priv_key)
-            self.priv_validator = unguarded
-            self.consensus.priv_validator = unguarded
-            self.consensus.priv_validator_pub_key = unguarded.get_pub_key()
-        hooks = {
-            "double_prevote": lambda: mb.double_prevote(self.switch),
-            "absent_prevote": lambda: mb.absent_prevote,
-        }
-        if name not in hooks:
-            raise ValueError(f"unknown misbehavior {name!r}")
-        self.consensus.misbehaviors["prevote"] = hooks[name]()
+        mb.install(self, spec)
 
     # --- lifecycle (reference: node/node.go:941 OnStart) -------------------
 

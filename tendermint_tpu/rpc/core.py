@@ -685,6 +685,17 @@ def light_block(env, height=0):
     )
 
     h = int(height)
+    # Byzantine-primary seam (consensus/misbehavior.py lunatic_proposer,
+    # docs/BYZANTINE.md): a maverick node carries a map of fabricated
+    # conflicting light blocks and serves THOSE to light clients instead
+    # of its honest store — the staged light-client attack the detector +
+    # evidence pipeline must catch. Production nodes never grow the
+    # attribute, so this is dead code outside adversarial runs.
+    fakes = getattr(env.node, "byzantine_light_blocks", None)
+    if fakes:
+        lb = fakes.get(h or env.node.block_store.height)
+        if lb is not None:
+            return {"height": str(lb.height), "light_block": lb.marshal().hex()}
     provider = NodeProvider(env.node.genesis.chain_id, env.node.block_store,
                             env.node.state_store)
     try:

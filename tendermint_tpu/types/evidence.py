@@ -17,7 +17,18 @@ from tendermint_tpu.types.vote import Vote
 
 
 class EvidenceError(Exception):
-    pass
+    """Typed evidence rejection. ``reason`` is a closed label set consumed
+    by the evidence reactor's rejection counter and peer scoring
+    (evidence/reactor.py, ``evidence_rejected_total{reason}``):
+    expired / bad_sig / unknown_validator / meta_mismatch / malformed /
+    invalid."""
+
+    REASONS = ("expired", "bad_sig", "unknown_validator", "meta_mismatch",
+               "malformed", "invalid")
+
+    def __init__(self, msg: str = "", reason: str = "invalid"):
+        super().__init__(msg)
+        self.reason = reason if reason in self.REASONS else "invalid"
 
 
 @dataclass
@@ -208,4 +219,4 @@ def evidence_unmarshal(buf: bytes):
         return DuplicateVoteEvidence.unmarshal_inner(f[1][-1])
     if 2 in f:
         return LightClientAttackEvidence.unmarshal_inner(f[2][-1])
-    raise EvidenceError("unknown evidence type")
+    raise EvidenceError("unknown evidence type", reason="malformed")

@@ -246,6 +246,14 @@ class NodeMetrics:
             "Front-door tx admissions by result: ok / reject (CheckTx or "
             "mempool verdict) / shed (the rpc_tx admission gate).",
             labels=("result",))
+        # evidence plane (evidence/reactor.py hardening, docs/BYZANTINE.md):
+        # the reason label universe is the closed EvidenceError.REASONS
+        # set, fully pre-seeded below
+        self.evidence_rejected = r.counter(
+            "evidence", "rejected_total",
+            "Gossiped evidence rejected before pooling (scored against "
+            "the delivering peer), by rejection reason.",
+            labels=("reason",))
         # p2p
         self.peers = r.gauge("p2p", "peers", "Number of connected peers.")
         self.peer_receive_bytes = r.counter(
@@ -338,6 +346,13 @@ class NodeMetrics:
         self.ingest_coalesced.add(0.0)
         for result in ("ok", "reject", "shed"):
             self.ingest_txs.add(0.0, result=result)
+        # evidence rejections: closed reason universe (types/evidence.py
+        # EvidenceError.REASONS), a node that never sees junk evidence
+        # scrapes explicit zeros
+        from tendermint_tpu.types.evidence import EvidenceError as _EvErr
+
+        for reason in _EvErr.REASONS:
+            self.evidence_rejected.add(0.0, reason=reason)
         # p2p byte counters follow the same convention (chID values are
         # bounded by the node's channel table, first traffic creates them)
         self.peer_receive_bytes.add(0.0, chID="")

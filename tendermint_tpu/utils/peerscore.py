@@ -56,6 +56,12 @@ OFFENSE_POINTS: dict[str, float] = {
     "oversized_message": 10.0,  # recv_message_capacity exceeded
     "evil_handshake": 50.0,     # claimed id != authenticated key
     "statesync_reject": 30.0,   # app reject_senders verdict on a chunk
+    "evidence_reject": 6.0,     # gossiped evidence the pool refused to
+                                # verify (bogus sigs / wrong chain-id /
+                                # expired / contradicting metadata) —
+                                # honest peers verified before pooling, so
+                                # sustained rejects are a protocol
+                                # violation (evidence/reactor.py)
     "checktx_reject": 0.02,     # gossiped tx the app rejected (honest-rate safe)
     "mempool_full": 0.02,       # gossiping into a full mempool (ours, usually)
     "tx_too_large": 8.0,        # gossiped tx over max_tx_bytes

@@ -1,0 +1,603 @@
+"""Byzantine adversary plane units (docs/BYZANTINE.md, ISSUE 14):
+behavior-spec grammar, the FilePV double-sign guard vs the maverick's
+unguarded signer, batched duplicate-vote evidence verification,
+light-client-attack byzantine attribution (lunatic / equivocation /
+amnesia), evidence-reactor hardening (scored rejects + the
+evidence_rejected_total counter), the soak `byz` grammar/generator
+invariants, and the auditor's evidence-lifecycle convergence logic."""
+
+import dataclasses
+
+import pytest
+
+from tendermint_tpu.consensus import misbehavior as mb
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.e2e import soak
+from tendermint_tpu.evidence.pool import EvidencePool
+from tendermint_tpu.evidence.reactor import EvidenceReactor, msg_evidence_list
+from tendermint_tpu.privval.file_pv import DoubleSignError, FilePV, MockPV
+from tendermint_tpu.types.block import Commit, CommitSig, Header
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    EvidenceError,
+    LightClientAttackEvidence,
+)
+from tendermint_tpu.types.light_block import LightBlock, SignedHeader
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import (
+    BLOCK_ID_FLAG_COMMIT,
+    PRECOMMIT_TYPE,
+    PREVOTE_TYPE,
+    Vote,
+)
+
+CHAIN_ID = "byz-chain"
+
+
+# ---------------------------------------------------------------------------
+# behavior-spec grammar
+# ---------------------------------------------------------------------------
+
+
+def test_spec_grammar_roundtrip():
+    for spec in ("double_prevote", "absent~4", "equivocate~3-5",
+                 "lunatic~7-", "amnesia~-9",
+                 "double_prevote~3-5+lunatic~7-", "double_precommit"):
+        windows = mb.parse_spec(spec)
+        assert mb.describe_spec(windows) == spec
+        again = mb.parse_spec(mb.describe_spec(windows))
+        assert again == windows
+
+
+def test_spec_grammar_windows():
+    (w,) = mb.parse_spec("equivocate~3-5")
+    assert not w.active(2) and w.active(3) and w.active(5) and not w.active(6)
+    (w,) = mb.parse_spec("lunatic~7-")
+    assert not w.active(6) and w.active(7) and w.active(10_000)
+    (w,) = mb.parse_spec("absent~4")
+    assert [h for h in range(1, 8) if w.active(h)] == [4]
+    (w,) = mb.parse_spec("double_prevote")
+    assert w.active(1) and w.active(999)
+
+
+def test_spec_grammar_rejects_unknown():
+    with pytest.raises(ValueError):
+        mb.parse_spec("nonsense")
+    with pytest.raises(ValueError):
+        mb.parse_spec("")
+
+
+# ---------------------------------------------------------------------------
+# FilePV double-sign guard (the safety property misbehavior.py's docstring
+# promises: a guarded signer REFUSES the equivocating second signature)
+# ---------------------------------------------------------------------------
+
+
+def _prevote(height, round_, block_hash):
+    return Vote(type=PREVOTE_TYPE, height=height, round=round_,
+                block_id=BlockID(hash=block_hash,
+                                 part_set_header=PartSetHeader()),
+                timestamp=Time(1_700_000_100, 0),
+                validator_address=b"\x01" * 20, validator_index=0)
+
+
+def test_filepv_refuses_equivocating_signature(tmp_path):
+    pv = FilePV.generate(str(tmp_path / "key.json"),
+                         str(tmp_path / "state.json"), seed=b"\x42" * 32)
+    vote_a = _prevote(5, 0, b"\xaa" * 32)
+    pv.sign_vote(CHAIN_ID, vote_a)
+    assert vote_a.signature
+    # the conflicting second prevote at the SAME H/R/S must be refused
+    vote_b = _prevote(5, 0, b"")
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN_ID, vote_b)
+    # ...and the guard survives a process restart (state file is fsync'd)
+    pv2 = FilePV.load(str(tmp_path / "key.json"), str(tmp_path / "state.json"))
+    with pytest.raises(DoubleSignError):
+        pv2.sign_vote(CHAIN_ID, _prevote(5, 0, b"\xbb" * 32))
+
+
+def test_mockpv_maverick_signs_conflicting_votes(tmp_path):
+    """The byzantine install swaps FilePV for a MockPV with the SAME key —
+    which happily signs the equivocating pair FilePV refuses."""
+    pv = FilePV.generate(str(tmp_path / "key.json"),
+                         str(tmp_path / "state.json"), seed=b"\x43" * 32)
+    unguarded = MockPV(pv.priv_key)
+    assert unguarded.get_address() == pv.get_address()
+    vote_a = _prevote(5, 0, b"\xaa" * 32)
+    vote_b = _prevote(5, 0, b"")
+    unguarded.sign_vote(CHAIN_ID, vote_a)
+    unguarded.sign_vote(CHAIN_ID, vote_b)
+    assert vote_a.signature and vote_b.signature
+    pub = pv.get_pub_key()
+    assert pub.verify_signature(vote_a.sign_bytes(CHAIN_ID), vote_a.signature)
+    assert pub.verify_signature(vote_b.sign_bytes(CHAIN_ID), vote_b.signature)
+
+
+# ---------------------------------------------------------------------------
+# batched duplicate-vote verification (evidence/pool.py through the
+# BatchVerifier registry: one 2-sig batch, serial error order preserved)
+# ---------------------------------------------------------------------------
+
+
+def _duplicate_vote_pair(priv, height=3, round_=0):
+    addr = priv.pub_key().address()
+    votes = []
+    for bh in (b"\xaa" * 32, b"\xcc" * 32):
+        v = Vote(type=PRECOMMIT_TYPE, height=height, round=round_,
+                 block_id=BlockID(hash=bh, part_set_header=PartSetHeader()),
+                 timestamp=Time(1_700_000_200, 0),
+                 validator_address=addr, validator_index=0)
+        v.signature = priv.sign(v.sign_bytes(CHAIN_ID))
+        votes.append(v)
+    votes.sort(key=lambda v: v.block_id.key())
+    return votes
+
+
+def test_verify_duplicate_vote_batched_accepts_valid_pair():
+    priv = ed25519.gen_priv_key(b"\x51" * 32)
+    val_set = ValidatorSet([Validator.new(priv.pub_key(), 10)])
+    va, vb = _duplicate_vote_pair(priv)
+    ev = DuplicateVoteEvidence(vote_a=va, vote_b=vb)
+    EvidencePool.verify_duplicate_vote(ev, CHAIN_ID, val_set)  # no raise
+
+
+def test_verify_duplicate_vote_batched_serial_error_order():
+    priv = ed25519.gen_priv_key(b"\x52" * 32)
+    val_set = ValidatorSet([Validator.new(priv.pub_key(), 10)])
+    for tamper_idx, want in ((0, "vote A"), (1, "vote B")):
+        va, vb = _duplicate_vote_pair(priv)
+        votes = [va, vb]
+        sig = votes[tamper_idx].signature
+        votes[tamper_idx].signature = sig[:-1] + bytes([sig[-1] ^ 1])
+        ev = DuplicateVoteEvidence(vote_a=votes[0], vote_b=votes[1])
+        with pytest.raises(EvidenceError) as ei:
+            EvidencePool.verify_duplicate_vote(ev, CHAIN_ID, val_set)
+        assert want in str(ei.value)
+        assert ei.value.reason == "bad_sig"
+    # both bad: the serial path reports vote A first
+    va, vb = _duplicate_vote_pair(priv)
+    va.signature = b"\x00" * 64
+    vb.signature = b"\x00" * 64
+    with pytest.raises(EvidenceError) as ei:
+        EvidencePool.verify_duplicate_vote(
+            DuplicateVoteEvidence(vote_a=va, vote_b=vb), CHAIN_ID, val_set)
+    assert "vote A" in str(ei.value)
+
+
+def test_verify_duplicate_vote_unknown_validator_reason():
+    priv = ed25519.gen_priv_key(b"\x53" * 32)
+    other = ed25519.gen_priv_key(b"\x54" * 32)
+    val_set = ValidatorSet([Validator.new(other.pub_key(), 10)])
+    va, vb = _duplicate_vote_pair(priv)
+    with pytest.raises(EvidenceError) as ei:
+        EvidencePool.verify_duplicate_vote(
+            DuplicateVoteEvidence(vote_a=va, vote_b=vb), CHAIN_ID, val_set)
+    assert ei.value.reason == "unknown_validator"
+
+
+# ---------------------------------------------------------------------------
+# light-client-attack byzantine attribution (types/evidence.py
+# get_byzantine_validators: lunatic / equivocation / amnesia)
+# ---------------------------------------------------------------------------
+
+
+def _attribution_fixture():
+    privs = [ed25519.gen_priv_key(bytes([60 + i]) * 32) for i in range(4)]
+    common = ValidatorSet([Validator.new(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    privs = [by_addr[v.address] for v in common.validators]  # sorted order
+    trusted_header = Header(
+        chain_id=CHAIN_ID, height=5, time=Time(1_700_000_500, 0),
+        validators_hash=common.hash(), next_validators_hash=common.hash(),
+        consensus_hash=b"\x11" * 32, app_hash=b"\x22" * 32,
+        last_results_hash=b"\x33" * 32, data_hash=b"\x44" * 32,
+        proposer_address=common.validators[0].address)
+    return privs, common, trusted_header
+
+
+def _commit(header, signers, round_=0, absent=()):
+    bid = BlockID(hash=header.hash(), part_set_header=PartSetHeader())
+    sigs = []
+    for i, val in enumerate(signers):
+        if i in absent:
+            sigs.append(CommitSig.new_absent())
+        else:
+            sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, val.address,
+                                  header.time, b"\x77" * 64))
+    return Commit(height=header.height, round=round_, block_id=bid,
+                  signatures=sigs)
+
+
+def test_attribution_lunatic_names_common_set_signers():
+    privs, common, trusted_header = _attribution_fixture()
+    attackers = common.validators[:2]
+    claimed = ValidatorSet([Validator.new(privs[i].pub_key(), 10)
+                            for i in range(2)])
+    fake_header = dataclasses.replace(
+        trusted_header, app_hash=b"\xde\xad" * 16,
+        validators_hash=claimed.hash(), next_validators_hash=claimed.hash())
+    ev = LightClientAttackEvidence(
+        conflicting_block=LightBlock(
+            SignedHeader(fake_header, _commit(fake_header, claimed.validators)),
+            claimed),
+        common_height=1)
+    trusted_sh = SignedHeader(trusted_header,
+                              _commit(trusted_header, common.validators))
+    byz = ev.get_byzantine_validators(common, trusted_sh)
+    assert {v.address for v in byz} == {v.address for v in attackers}
+    # attribution pulls the COMMON-set validator entries (old powers)
+    assert all(v.voting_power == 10 for v in byz)
+
+
+def test_attribution_equivocation_names_double_signers():
+    privs, common, trusted_header = _attribution_fixture()
+    # derived header (every state field matches), different data hash
+    conf_header = dataclasses.replace(trusted_header, data_hash=b"\x55" * 32)
+    # validators 0 and 1 signed BOTH commits; 2 and 3 absent on the fork
+    conf_commit = _commit(conf_header, common.validators, round_=0,
+                          absent=(2, 3))
+    trusted_commit = _commit(trusted_header, common.validators, round_=0)
+    ev = LightClientAttackEvidence(
+        conflicting_block=LightBlock(SignedHeader(conf_header, conf_commit),
+                                     common),
+        common_height=1)
+    byz = ev.get_byzantine_validators(
+        common, SignedHeader(trusted_header, trusted_commit))
+    assert {v.address for v in byz} == {common.validators[0].address,
+                                        common.validators[1].address}
+
+
+def test_attribution_amnesia_attributes_nobody():
+    """Different round + derived header: not attributable from the two
+    commits alone (the amnesia case) -> empty."""
+    privs, common, trusted_header = _attribution_fixture()
+    conf_header = dataclasses.replace(trusted_header, data_hash=b"\x55" * 32)
+    conf_commit = _commit(conf_header, common.validators, round_=1)
+    trusted_commit = _commit(trusted_header, common.validators, round_=0)
+    ev = LightClientAttackEvidence(
+        conflicting_block=LightBlock(SignedHeader(conf_header, conf_commit),
+                                     common),
+        common_height=1)
+    assert ev.get_byzantine_validators(
+        common, SignedHeader(trusted_header, trusted_commit)) == []
+
+
+# ---------------------------------------------------------------------------
+# evidence reactor hardening: unverifiable evidence is SCORED and counted,
+# our-limitation rejections stay unscored
+# ---------------------------------------------------------------------------
+
+
+class _StubPool:
+    version = 0
+
+    def __init__(self, exc=None):
+        self.exc = exc
+        self.added = []
+
+    def add_evidence(self, ev):
+        if self.exc is not None:
+            raise self.exc
+        self.added.append(ev)
+
+
+class _StubPeer:
+    def __init__(self, pid="peer-evil"):
+        self.id = pid
+
+
+class _StubSwitch:
+    def __init__(self, board):
+        self.scoreboard = board
+        self.logger = None
+
+
+def _some_evidence():
+    priv = ed25519.gen_priv_key(b"\x55" * 32)
+    va, vb = _duplicate_vote_pair(priv)
+    return DuplicateVoteEvidence(vote_a=va, vote_b=vb,
+                                 total_voting_power=10, validator_power=10,
+                                 timestamp=Time(1_700_000_200, 0))
+
+
+@pytest.fixture
+def _metrics(monkeypatch):
+    from tendermint_tpu.utils import metrics as tmmetrics
+
+    nm = tmmetrics.NodeMetrics()
+    monkeypatch.setattr(tmmetrics, "GLOBAL_NODE_METRICS", nm)
+    return nm
+
+
+def _rejected_count(nm, reason):
+    return nm.evidence_rejected._values.get((reason,), 0)
+
+
+def test_reactor_scores_unverifiable_evidence(_metrics):
+    from tendermint_tpu.utils.peerscore import PeerScoreBoard
+
+    board = PeerScoreBoard()
+    for exc, reason in (
+            (EvidenceError("bogus sig", reason="bad_sig"), "bad_sig"),
+            (EvidenceError("too old", reason="expired"), "expired"),
+            (EvidenceError("power mismatch", reason="meta_mismatch"),
+             "meta_mismatch")):
+        reactor = EvidenceReactor(_StubPool(exc=exc))
+        reactor.switch = _StubSwitch(board)
+        peer = _StubPeer()
+        before = board.score(peer.id)
+        reactor.receive(0x38, peer, msg_evidence_list([_some_evidence()]))
+        assert board.score(peer.id) > before, reason
+        assert _rejected_count(_metrics, reason) == 1, reason
+
+
+def test_reactor_scores_malformed_bytes(_metrics):
+    from tendermint_tpu.encoding import proto
+    from tendermint_tpu.utils.peerscore import PeerScoreBoard
+
+    board = PeerScoreBoard()
+    reactor = EvidenceReactor(_StubPool())
+    reactor.switch = _StubSwitch(board)
+    peer = _StubPeer()
+    garbage = proto.Writer().message(1, b"\xff\xff\xff\xff",
+                                     always=True).out()
+    reactor.receive(0x38, peer, garbage)
+    assert board.score(peer.id) > 0
+    assert _rejected_count(_metrics, "malformed") == 1
+
+
+def test_reactor_our_limitations_stay_unscored(_metrics):
+    from tendermint_tpu.state.store import StateStoreError
+    from tendermint_tpu.store.envelope import CorruptedStoreError
+    from tendermint_tpu.utils.peerscore import PeerScoreBoard
+
+    for exc in (StateStoreError("no state yet"),
+                CorruptedStoreError("block", b"k", "crc")):
+        board = PeerScoreBoard()
+        reactor = EvidenceReactor(_StubPool(exc=exc))
+        reactor.switch = _StubSwitch(board)
+        peer = _StubPeer()
+        reactor.receive(0x38, peer, msg_evidence_list([_some_evidence()]))
+        assert board.score(peer.id) == 0.0, type(exc).__name__
+    for reason in EvidenceError.REASONS:
+        assert _rejected_count(_metrics, reason) == 0
+
+
+def test_reactor_valid_evidence_unscored(_metrics):
+    from tendermint_tpu.utils.peerscore import PeerScoreBoard
+
+    board = PeerScoreBoard()
+    pool = _StubPool()
+    reactor = EvidenceReactor(pool)
+    reactor.switch = _StubSwitch(board)
+    peer = _StubPeer()
+    reactor.receive(0x38, peer, msg_evidence_list([_some_evidence()]))
+    assert len(pool.added) == 1
+    assert board.score(peer.id) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# soak grammar + generator invariants
+# ---------------------------------------------------------------------------
+
+
+def test_soak_byz_grammar_roundtrip():
+    for entry in ("@3:byz:5:double_precommit", "@4:byz:0:equivocate~8-12",
+                  "@5:byz:1:double_prevote~3-5+lunatic~7-",
+                  "@24:evidence:3"):
+        a = soak.SoakAction.parse(entry)
+        assert a.describe() == entry
+
+
+def test_soak_generator_byzantine_below_one_third():
+    """Generated schedules never put >= 1/3 of the (equal-power) nodes
+    under adversary control, and every byz arg parses as a behavior spec."""
+    for seed in range(12):
+        for nodes in (4, 7, 9):
+            sch = soak.SoakSchedule.generate(seed, 90.0, nodes)
+            assert soak.SoakSchedule.parse(sch.describe()).describe() == \
+                sch.describe()
+            byz_targets = set()
+            for a in sch.actions:
+                if a.kind == "byz":
+                    idx_s, _, spec = a.arg.partition(":")
+                    byz_targets.add(int(idx_s))
+                    assert mb.parse_spec(spec)
+                elif a.kind == "evidence":
+                    byz_targets.add(int(a.arg))
+            assert 3 * len(byz_targets) < nodes or not byz_targets, (
+                seed, nodes, sorted(byz_targets))
+
+
+# ---------------------------------------------------------------------------
+# auditor evidence-lifecycle convergence (stub cluster: pure logic)
+# ---------------------------------------------------------------------------
+
+
+class _StubBlock:
+    def __init__(self, evidence):
+        self.evidence = evidence
+
+
+class _StubStore:
+    base = 1
+
+    def __init__(self, blocks):
+        self.blocks = blocks  # height -> _StubBlock
+
+    def load_block(self, h):
+        return self.blocks.get(h)
+
+
+class _StubNode:
+    def __init__(self, blocks):
+        self.block_store = _StubStore(blocks)
+
+
+class _StubFab:
+    _gen = iter(range(1, 10_000))
+
+    def __init__(self, blocks):
+        self.node = _StubNode(blocks)
+        self.generation = next(self._gen)
+
+    @property
+    def height(self):
+        return max(self.node.block_store.blocks, default=0)
+
+
+class _StubCluster:
+    def __init__(self, per_node_blocks, byzantine=()):
+        self.nodes = {i: _StubFab(b) for i, b in per_node_blocks.items()}
+        self.byzantine = set(byzantine)
+
+    def block_hash(self, i, h):
+        return b"\x00" * 32 if h <= self.nodes[i].height else None
+
+
+def _blocks(tip, evidence_at):
+    return {h: _StubBlock(list(evidence_at.get(h, ())))
+            for h in range(1, tip + 1)}
+
+
+def test_auditor_evidence_converged_is_clean():
+    ev = _some_evidence()
+    blocks = {i: _blocks(12, {4: [ev]}) for i in range(3)}
+    auditor = soak.ContinuousAuditor(_StubCluster(blocks), evidence_bound=5)
+    auditor.sweep()
+    assert not auditor.violations
+    assert auditor.evidence_audited == 1
+
+
+def test_auditor_flags_missing_convergence_within_bound():
+    ev = _some_evidence()
+    blocks = {0: _blocks(12, {4: [ev]}),
+              1: _blocks(12, {4: [ev]}),
+              2: _blocks(12, {})}  # node 2 is past 4+5 and still lacks it
+    auditor = soak.ContinuousAuditor(_StubCluster(blocks), evidence_bound=5)
+    auditor.sweep()
+    kinds = [v.kind for v in auditor.violations]
+    assert kinds == ["evidence"], auditor.violations
+    assert "missing on node 2" in auditor.violations[0].detail
+    # flagged once, not re-reported every sweep
+    auditor.sweep()
+    assert len(auditor.violations) == 1
+
+
+def test_auditor_gives_laggards_the_height_bound():
+    ev = _some_evidence()
+    blocks = {0: _blocks(12, {4: [ev]}),
+              1: _blocks(12, {4: [ev]}),
+              2: _blocks(3, {})}  # tip 3 < 4+5: still inside the bound
+    auditor = soak.ContinuousAuditor(_StubCluster(blocks), evidence_bound=5)
+    auditor.sweep()
+    assert not auditor.violations
+    # the laggard catches up WITH the evidence in its height-4 block (the
+    # same chain every honest node commits): converged, still clean
+    blocks[2].update({h: _StubBlock([ev] if h == 4 else [])
+                      for h in range(4, 13)})
+    auditor.sweep()
+    assert not auditor.violations
+
+
+def test_auditor_flags_exactly_once_violation():
+    ev = _some_evidence()
+    blocks = {0: _blocks(12, {4: [ev], 9: [ev]}),   # committed twice!
+              1: _blocks(12, {4: [ev]}),
+              2: _blocks(12, {4: [ev]})}
+    auditor = soak.ContinuousAuditor(_StubCluster(blocks), evidence_bound=5)
+    auditor.sweep()
+    assert [v.kind for v in auditor.violations] == ["evidence"]
+    assert "TWICE" in auditor.violations[0].detail
+
+
+def test_auditor_restart_rescan_is_not_double_commit():
+    """A restarted honest node re-scans its full prefix (new generation
+    key); re-reading the SAME carrying block must not read as the
+    evidence being committed twice."""
+    ev = _some_evidence()
+    blocks = {i: _blocks(12, {4: [ev]}) for i in range(3)}
+    cluster = _StubCluster(blocks)
+    auditor = soak.ContinuousAuditor(cluster, evidence_bound=5)
+    auditor.sweep()
+    assert not auditor.violations
+    # simulate a restart: same chain, fresh node object + generation
+    cluster.nodes[1] = _StubFab(blocks[1])
+    auditor.sweep()
+    assert not auditor.violations, auditor.violations
+    # a REAL re-admission (same evidence at a second, NEWLY COMMITTED
+    # height past the incremental scan pointer) still flags
+    blocks[2][13] = _StubBlock([ev])
+    auditor.sweep()
+    assert [v.kind for v in auditor.violations] == ["evidence"]
+    assert "TWICE" in auditor.violations[0].detail
+
+
+class _StubConsensus:
+    def __init__(self):
+        self.on_new_round_step = []
+        self.misbehaviors = {}
+        self.priv_validator = None
+        self.priv_validator_pub_key = None
+
+
+class _StubByzNode:
+    def __init__(self):
+        self.consensus = _StubConsensus()
+        self.priv_validator = MockPV(ed25519.gen_priv_key(b"\x61" * 32))
+        self.switch = None
+        self.block_store = _StubStore({})
+        self.block_store.height = 0
+
+    class genesis:
+        chain_id = CHAIN_ID
+
+
+def test_install_cycling_unhooks_lunatic_fabricator():
+    """Behavior cycling replaces the whole map: a node cycled away from
+    lunatic must stop forging light blocks (the on_new_round_step
+    fabricator is unhooked, not leaked)."""
+    node = _StubByzNode()
+    mb.install(node, "lunatic~2-4")
+    assert len(node.consensus.on_new_round_step) == 1
+    assert node._byz_on_step == node.consensus.on_new_round_step
+    # re-install lunatic: replaced, not stacked
+    mb.install(node, "lunatic~2-4")
+    assert len(node.consensus.on_new_round_step) == 1
+    # cycle to a non-lunatic behavior: fabricator unhooked
+    mb.install(node, "absent")
+    assert node.consensus.on_new_round_step == []
+    assert node._byz_on_step == []
+    assert "prevote" in node.consensus.misbehaviors
+    assert "propose" not in node.consensus.misbehaviors
+
+
+def test_soak_behaviors_derive_from_catalog():
+    """The soak/generator behavior tables stay in lockstep with the
+    authoritative misbehavior catalog."""
+    from tendermint_tpu.e2e import generator
+
+    assert set(soak._BYZ_BEHAVIORS) == set(mb.BEHAVIORS) - {"absent_prevote"}
+    assert set(generator._BYZ_BEHAVIORS) == set(mb.BEHAVIORS) - {"absent"}
+
+
+def test_auditor_fork_audit_skips_byzantine_nodes():
+    class _ForkyCluster(_StubCluster):
+        def block_hash(self, i, h):
+            if h > self.nodes[i].height:
+                return None
+            return (b"\xff" * 32 if i == 9 else b"\x00" * 32)
+
+    blocks = {0: _blocks(5, {}), 1: _blocks(5, {}), 9: _blocks(5, {})}
+    # byzantine node 9's divergent store is NOT a fork violation...
+    auditor = soak.ContinuousAuditor(_ForkyCluster(blocks, byzantine={9}))
+    auditor.sweep()
+    assert not [v for v in auditor.violations if v.kind == "fork"]
+    # ...but an honest node diverging still is
+    auditor2 = soak.ContinuousAuditor(_ForkyCluster(blocks, byzantine=set()))
+    auditor2.sweep()
+    assert [v for v in auditor2.violations if v.kind == "fork"]

@@ -1,0 +1,160 @@
+"""Byzantine acceptance scenario (ISSUE 14, docs/BYZANTINE.md): a 7-node
+fabric with 2 byzantine nodes cycling through the whole maverick behavior
+catalog under a seeded soak schedule — honest nodes stay fork-free and
+live, every provoked misbehavior converges to identical committed evidence
+on all honest nodes within the height bound, and a live light-client
+attack (posterior-corruption lunatic as byzantine primary, honest witness,
+client OUTSIDE the cluster over real RPC) is detected, its evidence
+committed cluster-wide, and the voting-power slash applied at h+2."""
+
+import time
+
+import pytest
+
+from tendermint_tpu.e2e.fabric import Cluster
+from tendermint_tpu.e2e.soak import SoakDriver, SoakSchedule
+from tendermint_tpu.light.client import SKIPPING, Client, TrustOptions
+from tendermint_tpu.light.detector import ErrConflictingHeaders
+from tendermint_tpu.light.provider import HTTPProvider
+from tendermint_tpu.light.store import DBStore
+from tendermint_tpu.store.db import MemDB
+from tendermint_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    LightClientAttackEvidence,
+)
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.utils import faults, nemesis
+
+SEED = 14
+HONEST = (2, 3, 4, 5, 6)
+
+# the two byzantine nodes cycle through every behavior in the catalog:
+# node 0 (the demoted posterior-corruption lunatic) also equivocates as a
+# proposer; node 1 walks the vote-level behaviors
+CYCLE_SCHEDULE = (
+    "@0.5:byz:0:lunatic~2-4;"
+    "@2:byz:1:double_prevote;"
+    "@5:byz:1:double_precommit;"
+    "@7:byz:0:equivocate+lunatic~2-4;"
+    "@9:byz:1:amnesia;"
+    "@12:byz:1:absent;"
+    "@13:flood~1:4>3"
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    faults.configure([], seed=SEED)
+    nemesis.clear()
+    yield
+    nemesis.clear()
+    faults.clear()
+
+
+def _wait(cond, timeout, interval=0.25):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _committed_evidence(node):
+    out = {}
+    for h in range(1, node.block_store.height + 1):
+        block = node.block_store.load_block(h)
+        for ev in (block.evidence if block else ()):
+            out.setdefault(type(ev).__name__, []).append((h, ev.hash()))
+    return out
+
+
+def test_byzantine_acceptance_seven_nodes(tmp_path):
+    cluster = Cluster(str(tmp_path), 7,
+                      powers=[30, 4, 10, 10, 10, 10, 10],
+                      topology="full", rpc_nodes=(0, 2), trace=True)
+    cluster.start()
+    try:
+        # --- phase 1: honest warm-up, then demote the future lunatic so
+        # live byzantine power stays < 1/3 when it turns (the attack is
+        # staged by POSTERIOR CORRUPTION: the key held 30/84 >= 1/3 at the
+        # heights it will forge) -----------------------------------------
+        assert cluster.wait_min_height(3, 90.0), cluster.heights()
+        cluster.promote(0, 10)
+        assert _wait(lambda: cluster.validator_power(0) == 10, 60.0), (
+            cluster.validator_powers())
+
+        # --- phase 2: seeded soak cycling both byzantine nodes through
+        # the behavior catalog under tx load, with the continuous
+        # safety/liveness/evidence auditor attached ----------------------
+        schedule = SoakSchedule.parse(CYCLE_SCHEDULE)
+        assert schedule.describe() == CYCLE_SCHEDULE  # repro-line contract
+        driver = SoakDriver(cluster, schedule, SEED, duration_s=15.0,
+                            liveness_budget_s=60.0)
+        report = driver.run()
+        assert report.ok, (report.violations, report.repro)
+        assert report.byzantine == [0, 1]
+        byz_power, total = cluster.byzantine_power_fraction()
+        assert 3 * byz_power < total, (byz_power, total)
+        # the vote-level behaviors provoked committed DuplicateVoteEvidence
+        assert report.evidence_audited >= 1, report
+
+        # --- phase 3: the live light-client attack from OUTSIDE ---------
+        fakes = cluster.nodes[0].node.byzantine_light_blocks
+        assert 3 in fakes, sorted(fakes)
+        primary = HTTPProvider(cluster.chain_id, cluster.rpc_url(0))
+        witness = HTTPProvider(cluster.chain_id, cluster.rpc_url(2))
+        anchor = witness.light_block(1)
+        client = Client(
+            cluster.chain_id,
+            TrustOptions(period_s=1e9, height=1, hash=anchor.hash()),
+            primary, [witness], DBStore(MemDB()),
+            verification_mode=SKIPPING)
+        with pytest.raises(ErrConflictingHeaders):
+            client.verify_light_block_at_height(3, Time.now())
+        assert client.divergences
+        attack_ev = client.divergences[-1].evidence_against_primary
+        assert isinstance(attack_ev, LightClientAttackEvidence)
+        # attribution names the lunatic with its power AT THE COMMON HEIGHT
+        byz_vals = {v.address: v.voting_power
+                    for v in attack_ev.byzantine_validators}
+        lunatic_addr = cluster.nodes[0].priv.pub_key().address()
+        assert byz_vals == {lunatic_addr: 30}
+
+        # --- convergence: BOTH evidence kinds committed on EVERY honest
+        # node, exactly once each, within the auditor's height bound -----
+        def all_converged():
+            driver.auditor.sweep()  # keep the evidence ledger advancing
+            per_node = {i: _committed_evidence(cluster.nodes[i].node)
+                        for i in HONEST}
+            kinds_ok = all(
+                {"DuplicateVoteEvidence", "LightClientAttackEvidence"}
+                <= set(per_node[i]) for i in HONEST)
+            tracked = driver.auditor._ev_first
+            converged = driver.auditor._ev_converged
+            return kinds_ok and tracked and set(tracked) <= converged
+
+        assert _wait(all_converged, 120.0), {
+            i: sorted(_committed_evidence(cluster.nodes[i].node))
+            for i in HONEST}
+        assert not driver.auditor.violations, driver.auditor.violations
+        # identical evidence everywhere: same hash set on every honest node
+        hash_sets = []
+        for i in HONEST:
+            evs = _committed_evidence(cluster.nodes[i].node)
+            hash_sets.append({h for entries in evs.values()
+                              for _, h in entries})
+        assert all(s == hash_sets[0] for s in hash_sets[1:])
+
+        # --- slash at h+2: both byzantine validators at power 0 on every
+        # honest node's CURRENT set, and the honest majority stays live --
+        assert _wait(lambda: all(
+            cluster.validator_power(0, at=i) == 0
+            and cluster.validator_power(1, at=i) == 0
+            for i in HONEST), 90.0), cluster.validator_powers(at=2)
+        resume = cluster.max_height() + 2
+        assert cluster.wait_min_height(resume, 90.0, among=list(HONEST)), (
+            cluster.heights())
+        cluster.audit_agreement()  # honest prefix, full re-check
+    finally:
+        cluster.stop()
