@@ -943,6 +943,81 @@ def config_mempool_ingest(rr):
                 threads=n_threads, txs=n_txs)
 
 
+def config_chain_throughput(rr):
+    """ISSUE 17: end-to-end chain throughput (blocks/s) at 1000 mixed
+    validators with FULL blocks, replayed through the verify-ahead
+    pipeline against a socket-backed kvstore app — the batched execution
+    plane (DeliverTxBatch: one ABCI wire round trip per
+    TMTPU_DELIVER_MAX_BATCH chunk) vs TMTPU_DELIVER=0 (one round trip per
+    tx, the old serial loop). Both modes must converge to the same replay
+    app hash; the serial run is the config's own baseline
+    (speedup_vs_serial)."""
+    from tendermint_tpu.abci.client import ABCISocketClient
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.abci.server import ABCIServer
+    from tendermint_tpu.blockchain import pipeline as bpipe
+    from tendermint_tpu.blockchain.replay import ReplayCtx, make_chain
+
+    n_blocks = int(os.environ.get("BENCH_CHAIN_BLOCKS", 6))
+    txs_per_block = int(os.environ.get("BENCH_CHAIN_TXS", 512))
+    t0 = time.monotonic()
+    privs, vals = _mk_valset(700, 300)
+    blocks = make_chain(
+        BENCH_CHAIN, n_blocks + 1, vals, privs,
+        txs_for=lambda h: [b"c%d-%d=%d" % (h, i, (h * 131 + i) % 9973)
+                           for i in range(txs_per_block)])
+    gen_s = time.monotonic() - t0
+
+    def run(batched: bool) -> bytes:
+        """One full replay: fresh app + socket per run so both modes
+        apply the identical chain from genesis state."""
+        prev = os.environ.get("TMTPU_DELIVER")
+        os.environ["TMTPU_DELIVER"] = "1" if batched else "0"
+        server = ABCIServer(KVStoreApplication(), "tcp://127.0.0.1:0")
+        server.start()
+        cli = None
+        try:
+            cli = ABCISocketClient(server.addr)
+            ctx = ReplayCtx(vals, BENCH_CHAIN, app=cli)
+            for i, b in enumerate(blocks):
+                ctx.pool.add_block("pA" if i % 2 == 0 else "pB", b)
+            pipe = bpipe.VerifyAheadPipeline()
+            while pipe.process_next(ctx):
+                pass
+            assert not ctx.punished and len(ctx.applied) == n_blocks, (
+                ctx.punished, ctx.applied)
+            return ctx.app_hash
+        finally:
+            if cli is not None:
+                cli.close()
+            server.stop()
+            if prev is None:
+                os.environ.pop("TMTPU_DELIVER", None)
+            else:
+                os.environ["TMTPU_DELIVER"] = prev
+
+    # Correctness gate (also warms kernels/keysets/allocator for both
+    # modes): identical replay app hash batched vs serial.
+    hb, hs = run(True), run(False)
+    assert hb == hs, "batched replay app hash != serial"
+
+    vb, detail = rr.run(lambda: run(True), iters=2, rounds=2, report="min")
+    vs, _ = rr.run(lambda: run(False), iters=2, rounds=2, report="min")
+    bps_b = n_blocks / (vb / 1e3)
+    bps_s = n_blocks / (vs / 1e3)
+    # serial CPU anchor: one core verifying the block's +2/3 light prefix
+    # PLUS one socket round trip per tx (measured by the serial mode) —
+    # vs_baseline for this config IS the speedup over that serial loop.
+    speedup = bps_b / max(bps_s, 1e-9)
+    return dict(metric=f"chain_throughput_1000v_{txs_per_block}tx_blocks_per_s",
+                value=round(bps_b, 2), unit="blocks/s",
+                vs_baseline=round(speedup, 2),
+                speedup_vs_serial=round(speedup, 2),
+                serial_blocks_per_s=round(bps_s, 2),
+                txs_per_block=txs_per_block, n_blocks=n_blocks,
+                gen_s=round(gen_s, 1), **detail)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -1012,6 +1087,7 @@ def main() -> None:
         ("addvote", config_addvote, (rr,)),
         ("concurrent_verify", config_concurrent_verify, (rr,)),
         ("mempool_ingest", config_mempool_ingest, (rr,)),
+        ("chain_throughput", config_chain_throughput, (rr,)),
         ("sharded", config_sharded, (rr, items)),
     ):
         try:
@@ -1048,6 +1124,8 @@ def main() -> None:
                                   "service_stats",
                                   "speedup_vs_serial",
                                   "serial_txs_per_s",
+                                  "serial_blocks_per_s",
+                                  "txs_per_block",
                                   "p99_admission_ms_batched",
                                   "p99_admission_ms_serial",
                                   "p50_admission_ms_batched",

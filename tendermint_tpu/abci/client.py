@@ -39,6 +39,9 @@ class ABCISocketClient:
         # the server doesn't know the wire extension, whatever its error
         # wording); True/False is the cached verdict (docs/INGEST.md)
         self._batch_checktx: bool | None = None
+        # same probe discipline for the deliver_tx_batch extension
+        # (fields 21/22, docs/EXECUTION.md)
+        self._batch_delivertx: bool | None = None
         self._connect(connect_retries, retry_interval_s)
 
     def _connect(self, retries: int, interval: float) -> None:
@@ -161,6 +164,30 @@ class ABCISocketClient:
 
     def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
         return self._call("deliver_tx", req)
+
+    def deliver_tx_batch(self, req: abci.RequestDeliverTxBatch) -> abci.ResponseDeliverTxBatch:
+        """One round trip for a whole block chunk (wire extension fields
+        21/22), probed exactly like check_tx_batch: the first use sends an
+        EMPTY batch — structural, no app code runs, so an error can only
+        mean the server doesn't decode the extension — and the verdict is
+        cached for the connection's lifetime. Errors on REAL batches
+        propagate untouched: DeliverTx mutates app state, so the caller
+        must see the serial loop's exact failure shape (prefix executed,
+        then raise) rather than a silent retry that would double-apply."""
+        if self._batch_delivertx is None:
+            try:
+                self._call("deliver_tx_batch", abci.RequestDeliverTxBatch(txs=[]))
+                self._batch_delivertx = True
+            except (wire.ABCIRemoteError, ABCIClientError):
+                # unknown-request answer (and, for servers that tear the
+                # connection down after it, a dead socket): no extension
+                self._batch_delivertx = False
+                self._reconnect()
+        if self._batch_delivertx:
+            return self._call("deliver_tx_batch", req)
+        return abci.ResponseDeliverTxBatch(responses=[
+            self.deliver_tx(abci.RequestDeliverTx(tx=tx)) for tx in req.txs
+        ])
 
     def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
         return self._call("end_block", req)

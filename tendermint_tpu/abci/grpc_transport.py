@@ -35,6 +35,8 @@ _METHODS = {
     # the serial loop
     "CheckTxBatch": ("check_tx_batch", 19, 20),
     "DeliverTx": ("deliver_tx", 9, 10),
+    # extension method (docs/EXECUTION.md): same contract as CheckTxBatch
+    "DeliverTxBatch": ("deliver_tx_batch", 21, 22),
     "EndBlock": ("end_block", 10, 11),
     "Commit": (wire.COMMIT, 11, 12),
     "ListSnapshots": ("list_snapshots", 12, 13),
@@ -127,6 +129,7 @@ class ABCIGrpcClient:
     def __init__(self, addr: str, timeout_s: float = 10.0):
         self.timeout_s = timeout_s
         self._batch_checktx = True  # until a server answers UNIMPLEMENTED
+        self._batch_delivertx = True  # ditto for DeliverTxBatch
         self._channel = grpc.insecure_channel(addr.split("://", 1)[-1])
         self._calls = {
             name: self._channel.unary_unary(
@@ -206,6 +209,24 @@ class ABCIGrpcClient:
 
     def deliver_tx(self, req):
         return self._call("DeliverTx", req)
+
+    def deliver_tx_batch(self, req):
+        """One RPC for a whole block chunk. Only UNIMPLEMENTED disables the
+        extension — that status means the method was never routed to app
+        code, so falling back to the serial loop cannot double-apply any
+        tx. App exceptions (INTERNAL → ABCIRemoteError) and transport
+        faults propagate: state may have partially advanced, exactly like
+        the serial loop raising mid-block."""
+        if self._batch_delivertx:
+            try:
+                return self._call("DeliverTxBatch", req)
+            except grpc.RpcError as e:
+                if e.code() != grpc.StatusCode.UNIMPLEMENTED:
+                    raise
+                self._batch_delivertx = False
+        return abci.ResponseDeliverTxBatch(responses=[
+            self.deliver_tx(abci.RequestDeliverTx(tx=tx)) for tx in req.txs
+        ])
 
     def end_block(self, req):
         return self._call("EndBlock", req)

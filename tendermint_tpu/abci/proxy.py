@@ -22,7 +22,8 @@ from tendermint_tpu.abci import types as abci
 
 _APP_METHODS = (
     "info", "set_option", "query", "check_tx", "check_tx_batch",
-    "init_chain", "begin_block", "deliver_tx", "end_block", "commit",
+    "init_chain", "begin_block", "deliver_tx", "deliver_tx_batch",
+    "end_block", "commit",
     "list_snapshots", "offer_snapshot", "load_snapshot_chunk",
     "apply_snapshot_chunk",
 )

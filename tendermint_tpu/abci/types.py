@@ -179,6 +179,17 @@ class RequestDeliverTx:
 
 
 @dataclass
+class RequestDeliverTxBatch:
+    """Batched DeliverTx: one ABCI round trip executes a whole block chunk
+    (no reference analogue — the batched execution plane, docs/EXECUTION.md).
+    Carried on wire-extension oneof fields 21/22 (abci/wire.py); apps that
+    don't override the Application shim get exact per-tx loop semantics,
+    including the serial loop's failure shape (prefix executed, then raise)."""
+
+    txs: list[bytes] = field(default_factory=list)
+
+
+@dataclass
 class RequestEndBlock:
     height: int = 0
 
@@ -346,6 +357,13 @@ class ResponseDeliverTx:
 
 
 @dataclass
+class ResponseDeliverTxBatch:
+    """Per-tx responses, order-aligned with RequestDeliverTxBatch.txs."""
+
+    responses: list[ResponseDeliverTx] = field(default_factory=list)
+
+
+@dataclass
 class ResponseEndBlock:
     validator_updates: list[ValidatorUpdate] = field(default_factory=list)
     consensus_param_updates: object | None = None
@@ -428,6 +446,15 @@ class Application:
 
     def deliver_tx(self, req: RequestDeliverTx) -> ResponseDeliverTx:
         return ResponseDeliverTx()
+
+    def deliver_tx_batch(self, req: RequestDeliverTxBatch) -> ResponseDeliverTxBatch:
+        """Loop-fallback shim: apps that don't implement batched DeliverTx
+        get the serial loop's exact per-tx semantics — if tx k raises, txs
+        0..k-1 have already mutated app state and the exception propagates,
+        identical to the caller running the loop itself (docs/EXECUTION.md)."""
+        return ResponseDeliverTxBatch(responses=[
+            self.deliver_tx(RequestDeliverTx(tx=tx)) for tx in req.txs
+        ])
 
     def end_block(self, req: RequestEndBlock) -> ResponseEndBlock:
         return ResponseEndBlock()

@@ -13,9 +13,12 @@ wire-compatible with a Go tendermint v0.34 socket app:
             commit=12 list_snapshots=13 offer_snapshot=14
             load_snapshot_chunk=15 apply_snapshot_chunk=16
 
-Extension (NOT in the reference proto — this tree's ingestion front door,
-docs/INGEST.md): check_tx_batch rides Request field 19 / Response field 20;
-clients fall back to a serial CheckTx loop against pre-batch servers.
+Extensions (NOT in the reference proto):
+  check_tx_batch rides Request field 19 / Response field 20 (this tree's
+  ingestion front door, docs/INGEST.md); deliver_tx_batch rides Request
+  field 21 / Response field 22 (the batched execution plane,
+  docs/EXECUTION.md). Clients fall back to serial per-tx loops against
+  pre-batch servers.
 """
 
 from __future__ import annotations
@@ -217,6 +220,15 @@ def encode_request(kind: str, req=None) -> bytes:
         w.message(19, bw.out(), always=True)
     elif kind == "deliver_tx":
         w.message(9, proto.Writer().bytes(1, req.tx).out(), always=True)
+    elif kind == "deliver_tx_batch":
+        # extension field (not in the reference proto): one round trip
+        # executes a whole block chunk (docs/EXECUTION.md)
+        bw = proto.Writer()
+        for t in req.txs:
+            # message(always=True), not bytes(): a repeated element must
+            # be emitted even when empty, or the batch shape collapses
+            bw.message(1, t, always=True)
+        w.message(21, bw.out(), always=True)
     elif kind == "end_block":
         w.message(10, proto.Writer().varint(1, req.height).out(), always=True)
     elif kind == COMMIT:
@@ -319,6 +331,10 @@ def decode_request(buf: bytes) -> tuple[str, object]:
         return "check_tx_batch", abci.RequestCheckTxBatch(
             txs=list(m.get(1, [])),
             type=proto.as_sint64(m.get(2, [0])[-1]))
+    if 21 in f:  # extension: batched DeliverTx (docs/EXECUTION.md)
+        m = proto.fields(f[21][-1])
+        return "deliver_tx_batch", abci.RequestDeliverTxBatch(
+            txs=list(m.get(1, [])))
     if 4 in f:  # set_option (deprecated in the reference, kept for parity)
         m = proto.fields(f[4][-1])
         return "set_option", (
@@ -375,6 +391,11 @@ def encode_response(kind: str, resp=None, error: str | None = None) -> bytes:
         w.message(20, bw.out(), always=True)
     elif kind == "deliver_tx":
         w.message(10, resp.marshal(), always=True)
+    elif kind == "deliver_tx_batch":
+        bw = proto.Writer()
+        for rtx in resp.responses:
+            bw.message(1, rtx.marshal(), always=True)
+        w.message(22, bw.out(), always=True)
     elif kind == "end_block":
         ew = proto.Writer()
         for v in resp.validator_updates:
@@ -466,6 +487,10 @@ def decode_response(buf: bytes) -> tuple[str, object]:
         m = proto.fields(f[20][-1])
         return "check_tx_batch", abci.ResponseCheckTxBatch(
             responses=[_check_tx_resp_unmarshal(b) for b in m.get(1, [])])
+    if 22 in f:  # extension: batched DeliverTx (docs/EXECUTION.md)
+        m = proto.fields(f[22][-1])
+        return "deliver_tx_batch", abci.ResponseDeliverTxBatch(
+            responses=[abci.ResponseDeliverTx.unmarshal(b) for b in m.get(1, [])])
     if 10 in f:
         return "deliver_tx", abci.ResponseDeliverTx.unmarshal(f[10][-1])
     if 11 in f:

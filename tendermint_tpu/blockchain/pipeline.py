@@ -234,9 +234,27 @@ class VerifyAheadPipeline:
             reactor._punish_invalid(head.height, e)
             return False
         pool.pop_request()
+        # Commit→apply overlap (docs/EXECUTION.md), both directions:
+        # (a) with h popped, h+1 is the new pool head — top the
+        #     speculative window up NOW so h+1's commit verification is
+        #     in flight on-device while h saves/applies below (validator
+        #     churn in this apply is caught by the next iteration's
+        #     stale-input check and re-dispatched);
+        # (b) dispatch h's own LastCommit re-verification (apply_block's
+        #     internal validate) so it rides under the block-store save.
+        self._fill(reactor)
+        # duck-typed executors (headless replay / test stubs) don't
+        # speculate and keep their plain apply_block signature
+        dispatch = getattr(reactor.block_exec, "dispatch_commit_verify", None)
+        commit_pending = dispatch(reactor.state, head.first) if dispatch else None
         with _trace.current().span("fastsync.apply", height=head.height):
             reactor.block_store.save_block(head.first, head.first_parts,
                                            head.second.last_commit)
-            reactor.state, _ = reactor.block_exec.apply_block(
-                reactor.state, head.first_id, head.first)
+            if dispatch is not None:
+                reactor.state, _ = reactor.block_exec.apply_block(
+                    reactor.state, head.first_id, head.first,
+                    commit_pending=commit_pending)
+            else:
+                reactor.state, _ = reactor.block_exec.apply_block(
+                    reactor.state, head.first_id, head.first)
         return True

@@ -32,9 +32,12 @@ def signed_commit(chain_id, vals, privs, height, bid, ts, round_=1):
     return Commit(height=height, round=round_, block_id=bid, signatures=sigs)
 
 
-def make_chain(chain_id, n, vals, privs):
+def make_chain(chain_id, n, vals, privs, txs_for=None):
     """n chained blocks with real part-set block IDs in each LastCommit —
-    what the fast-sync verify checks."""
+    what the fast-sync verify checks. `txs_for(height) -> list[bytes]`
+    optionally fills each block's Data (the batched-execution bench and
+    replay-equivalence tests feed full blocks through here; empty blocks
+    otherwise, as before)."""
     blocks, prev_commit, prev_bid = [], None, BlockID()
     for h in range(1, n + 1):
         header = Header(chain_id=chain_id, height=h,
@@ -42,7 +45,8 @@ def make_chain(chain_id, n, vals, privs):
                         last_block_id=prev_bid, validators_hash=vals.hash(),
                         next_validators_hash=vals.hash(),
                         proposer_address=vals.validators[0].address)
-        block = Block(header=header, data=Data(), last_commit=prev_commit)
+        data = Data(txs=list(txs_for(h))) if txs_for is not None else Data()
+        block = Block(header=header, data=data, last_commit=prev_commit)
         bhash = block.hash()
         parts = PartSet.from_data(block.marshal())
         bid = BlockID(hash=bhash, part_set_header=parts.header())
@@ -58,13 +62,14 @@ class ReplayCtx:
     stub store/executor, app hash chained over accepted block IDs (two
     replays accepting the same blocks in the same order agree)."""
 
-    def __init__(self, vals, chain_id):
+    def __init__(self, vals, chain_id, app=None):
         self.pool = BlockPool(1)
         self.state = pytypes.SimpleNamespace(validators=vals,
                                              chain_id=chain_id)
         self.applied: list[int] = []
         self.punished: list[str] = []
         self.app_hash = b"\x00" * 32
+        self.app = app
         outer = self
 
         class _Store:
@@ -72,10 +77,27 @@ class ReplayCtx:
                 pass
 
         class _Exec:
-            def apply_block(self, state, block_id, block):
+            def apply_block(self, state, block_id, block, commit_pending=None):
                 outer.applied.append(block.header.height)
-                outer.app_hash = hashlib.sha256(
-                    outer.app_hash + block_id.hash).digest()
+                if outer.app is None:
+                    outer.app_hash = hashlib.sha256(
+                        outer.app_hash + block_id.hash).digest()
+                else:
+                    # app-backed replay: the block's txs run through the
+                    # shared deliver engine (docs/EXECUTION.md), so the
+                    # bench / equivalence tests exercise the same batched
+                    # vs serial paths the real BlockExecutor does
+                    from tendermint_tpu.abci import types as abci
+                    from tendermint_tpu.state.execution import deliver_block_txs
+
+                    outer.app.begin_block(abci.RequestBeginBlock(
+                        hash=block.hash() or b"", header=block.header))
+                    deliver_block_txs(outer.app, block.data.txs)
+                    outer.app.end_block(
+                        abci.RequestEndBlock(height=block.header.height))
+                    res = outer.app.commit()
+                    outer.app_hash = hashlib.sha256(
+                        outer.app_hash + block_id.hash + res.data).digest()
                 return state, 0
 
         self.block_store = _Store()

@@ -164,7 +164,9 @@ class Handshaker:
         app.begin_block(abci.RequestBeginBlock(
             hash=block.hash() or b"", header=block.header,
             last_commit_info=commit_info))
-        for tx in block.data.txs:
-            app.deliver_tx(abci.RequestDeliverTx(tx=tx))
+        # the shared deliver engine (docs/EXECUTION.md): handshake replay
+        # produces the same app hashes through the batched path as the
+        # serial loop, chunking and fallback included
+        sm_exec.deliver_block_txs(app, block.data.txs)
         app.end_block(abci.RequestEndBlock(height=block.header.height))
         app.commit()

@@ -1168,7 +1168,14 @@ class ConsensusState:
             raise ConsensusError("expected ProposalBlockParts header to be commit header")
         if not block.hashes_to(block_id.hash):
             raise ConsensusError("cannot finalize commit; proposal block does not hash to commit hash")
-        self.block_exec.validate_block(self.state, block)
+        # commit→apply overlap (docs/EXECUTION.md): dispatch the block's
+        # LastCommit verification on-device now so the round trip rides
+        # under the structural checks; the resolved handle then makes
+        # apply_block's re-validation free (resolve() is idempotent),
+        # collapsing the path's two synchronous verifies into one async one.
+        commit_pending = self.block_exec.dispatch_commit_verify(self.state, block)
+        self.block_exec.validate_block(self.state, block,
+                                       commit_pending=commit_pending)
 
         from tendermint_tpu.utils import faults
 
@@ -1192,6 +1199,7 @@ class ConsensusState:
                 state_copy,
                 BlockID(hash=block.hash(), part_set_header=block_parts.header()),
                 block,
+                commit_pending=commit_pending,
             )
 
         # crash site 4 (reference: state.go:1667)

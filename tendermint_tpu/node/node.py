@@ -495,6 +495,10 @@ class Node:
         if self.metrics_server is not None:
             self.metrics_server.stop()
         self.consensus.stop()
+        # drain queued post-commit event publishes (so indexers/subscribers
+        # see every committed height), then park the worker thread
+        self.block_exec.flush_post_commit(timeout_s=5.0)
+        self.block_exec.stop()
         self.switch.stop()
         if getattr(self, "signer_endpoint", None) is not None:
             self.signer_endpoint.close()

@@ -1,10 +1,21 @@
 """BlockExecutor: validates blocks, drives the ABCI app, applies validator
 updates (reference: state/execution.go:94,117,131,211,259,403).
+
+This module also owns the batched execution plane (docs/EXECUTION.md):
+`deliver_block_txs` is the ONE deliver engine every DeliverTx loop in the
+tree goes through (block apply, handshake replay, bench, entry gates), so
+the batched and serial paths cannot drift; `PostCommitWorker` moves event
+publish off the apply critical path; `dispatch_commit_verify` is the
+commit→apply overlap seam that lets a block's LastCommit verification ride
+the device while host-side work (store save, WAL fsync) proceeds.
 """
 
 from __future__ import annotations
 
-from dataclasses import replace
+import os
+import queue
+import threading
+from dataclasses import dataclass, replace
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.crypto import keys as crypto_keys
@@ -17,10 +28,192 @@ from tendermint_tpu.types.params import ConsensusParams
 from tendermint_tpu.types.ttime import Time
 from tendermint_tpu.types.validator import Validator
 from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.utils import faults
+from tendermint_tpu.utils import trace as _trace
 
 
 class BlockExecutionError(Exception):
     pass
+
+
+# --- the batched deliver engine (docs/EXECUTION.md) -------------------------
+
+
+def deliver_enabled() -> bool:
+    """`TMTPU_DELIVER=0` restores the serial per-tx DeliverTx loop. Read
+    per call so tests and the chain_throughput bench flip it live."""
+    return os.environ.get("TMTPU_DELIVER") != "0"
+
+
+def deliver_max_batch(default: int = 1024) -> int:
+    """Tx cap per batched DeliverTx round trip (`TMTPU_DELIVER_MAX_BATCH`):
+    bounds one wire message's size and the app's worst-case batched call."""
+    try:
+        v = int(os.environ.get("TMTPU_DELIVER_MAX_BATCH", default))
+    except ValueError:
+        return default
+    return max(1, v)
+
+
+def deliver_block_txs(app, txs) -> list[abci.ResponseDeliverTx]:
+    """Execute a block's txs against the app: one ABCI round trip per
+    `deliver_max_batch()`-sized chunk (wire extension fields 21/22), with
+    per-tx responses order-aligned and bit-identical to the serial loop's.
+
+    Degradation to the serial loop happens ONLY when provably no app code
+    ran for the chunk: the `abci.deliver_batch` fault site fires BEFORE
+    dispatch, apps without the batch method never get called, and the
+    transports fall back only on structural probe / UNIMPLEMENTED
+    evidence. A genuine app or transport error during a real batch
+    PROPAGATES — the chunk's prefix has already mutated app state, which
+    is exactly the serial loop's failure shape, and a silent redo would
+    double-apply it.
+    """
+    txs = list(txs)
+    if not txs:
+        return []
+    batch_fn = getattr(app, "deliver_tx_batch", None)
+    if batch_fn is None or not deliver_enabled():
+        return [app.deliver_tx(abci.RequestDeliverTx(tx=tx)) for tx in txs]
+    out: list[abci.ResponseDeliverTx] = []
+    cap = deliver_max_batch()
+    with _trace.current().span("abci.deliver_txs", n=len(txs)):
+        for start in range(0, len(txs), cap):
+            chunk = txs[start:start + cap]
+            try:
+                faults.fire("abci.deliver_batch")
+            except Exception:  # noqa: BLE001 - injected pre-dispatch: no
+                # app code has run for this chunk, so the serial loop is
+                # safe (cannot double-apply)
+                out.extend(app.deliver_tx(abci.RequestDeliverTx(tx=tx))
+                           for tx in chunk)
+                continue
+            with _trace.current().span("abci.deliver_batch", n=len(chunk)):
+                rs = batch_fn(abci.RequestDeliverTxBatch(txs=chunk)).responses
+            if len(rs) != len(chunk):
+                raise BlockExecutionError(
+                    f"batched DeliverTx returned {len(rs)} responses "
+                    f"for {len(chunk)} txs")
+            _observe_deliver_batch(len(chunk))
+            out.extend(rs)
+    return out
+
+
+def _observe_deliver_batch(n: int) -> None:
+    from tendermint_tpu.utils import metrics as tmmetrics
+
+    m = tmmetrics.GLOBAL_NODE_METRICS
+    if m is None:
+        return
+    try:
+        m.deliver_batch_size.observe(float(n))
+    except Exception:  # noqa: BLE001 - observability never fails the apply
+        pass
+
+
+def _observe_invalid_txs(n: int) -> None:
+    from tendermint_tpu.utils import metrics as tmmetrics
+
+    m = tmmetrics.GLOBAL_NODE_METRICS
+    if m is None or n == 0:
+        return
+    try:
+        m.abci_deliver_tx_invalid_total.add(float(n))
+    except Exception:  # noqa: BLE001 - observability never fails the apply
+        pass
+
+
+# --- post-commit worker (docs/EXECUTION.md) ---------------------------------
+
+
+class PostCommitWorker:
+    """Single FIFO daemon thread for post-commit work (event publish →
+    tx index, RPC subscribers) so `apply_block` returns as soon as state
+    is durably saved. One queue, one thread: work for height h runs
+    before work for h+1, the ordering subscribers rely on. Crash-shielded:
+    a failing task is dropped and later heights still publish."""
+
+    _STOP = object()
+
+    def __init__(self, logger=None):
+        self._logger = logger
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._thread: threading.Thread | None = None
+        self._mtx = threading.Lock()
+
+    def submit(self, fn) -> None:
+        with self._mtx:
+            t = self._thread
+            if t is None or not t.is_alive():
+                t = threading.Thread(target=self._run, name="post-commit",
+                                     daemon=True)
+                self._thread = t
+                t.start()
+        self._q.put(fn)
+
+    def flush(self, timeout_s: float = 10.0) -> bool:
+        """Block until everything submitted so far has run (tests,
+        Node.stop). Returns False on timeout."""
+        with self._mtx:
+            t = self._thread
+        if t is None or not t.is_alive():
+            return True
+        done = threading.Event()
+        self._q.put(done.set)
+        return done.wait(timeout_s)
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        with self._mtx:
+            t = self._thread
+            self._thread = None
+        if t is None or not t.is_alive():
+            return
+        self._q.put(self._STOP)
+        t.join(timeout_s)
+
+    def _run(self) -> None:
+        try:
+            while True:
+                fn = self._q.get()
+                if fn is PostCommitWorker._STOP:
+                    return
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 - post-commit work must
+                    # never kill the worker; later heights still publish
+                    if self._logger is not None:
+                        try:
+                            self._logger.error("post-commit task failed")
+                        except Exception:  # noqa: BLE001
+                            pass
+        except Exception:  # noqa: BLE001 - crash shield (docs/LINT.md)
+            pass
+
+
+# --- commit→apply overlap seam (docs/EXECUTION.md) --------------------------
+
+
+@dataclass
+class SpeculativeCommitVerify:
+    """A block's LastCommit verification dispatched on-device ahead of the
+    apply, plus the dispatch-time inputs that make it safe to consume:
+    the handle is used only if height / last_block_id / validator-set
+    hash still match at resolve time, otherwise it is silently discarded
+    and the apply falls back to the synchronous verify (the PIPELINE.md
+    stale-input discipline)."""
+
+    pending: object  # types.validator_set.PendingCommitVerify
+    height: int
+    last_block_id: BlockID
+    vals_hash: bytes
+
+    def fresh_for(self, state: State, block: Block):
+        """The inner pending handle iff dispatch-time inputs still hold."""
+        if (self.height == block.header.height
+                and self.last_block_id == state.last_block_id
+                and self.vals_hash == state.last_validators.hash()):
+            return self.pending
+        return None
 
 
 def validator_updates_from_abci(updates: list[abci.ValidatorUpdate]) -> list[Validator]:
@@ -59,6 +252,8 @@ class BlockExecutor:
         self.block_store = block_store
         self.logger = logger
         self.metrics = metrics
+        # lazy: no thread until the first post-commit submission
+        self._post_commit = PostCommitWorker(logger)
 
     # --- proposal creation (reference: state/execution.go:94-129) ----------
 
@@ -78,20 +273,52 @@ class BlockExecutor:
         return state.make_block(height, txs, last_commit, evidence, proposer_address,
                                 block_time)
 
-    def validate_block(self, state: State, block: Block) -> None:
-        validate_block(state, block, self.block_store)
+    def validate_block(self, state: State, block: Block,
+                       commit_pending: SpeculativeCommitVerify | None = None) -> None:
+        inner = commit_pending.fresh_for(state, block) if commit_pending else None
+        validate_block(state, block, self.block_store, commit_pending=inner)
         if self.evidence_pool is not None:
             self.evidence_pool.check_evidence(state, block.evidence)
 
+    def dispatch_commit_verify(self, state: State,
+                               block: Block) -> SpeculativeCommitVerify | None:
+        """Dispatch `block.last_commit`'s verification on-device NOW and
+        return a stale-guarded handle that `validate_block`/`apply_block`
+        resolve later — the commit→apply overlap seam: the device round
+        trip rides under host-side work (structural checks, store save,
+        WAL fsync) instead of serializing with it. `resolve()` replays the
+        exact serial accept/reject decision and is idempotent, so passing
+        one handle through both the pre-save validate and the apply costs
+        one verification total. Returns None when there is nothing to
+        verify (the initial block)."""
+        if block.header.height == state.initial_height:
+            return None
+        pending = state.last_validators.verify_commit_async(
+            state.chain_id, state.last_block_id,
+            block.header.height - 1, block.last_commit)
+        return SpeculativeCommitVerify(
+            pending=pending, height=block.header.height,
+            last_block_id=state.last_block_id,
+            vals_hash=state.last_validators.hash())
+
+    def flush_post_commit(self, timeout_s: float = 10.0) -> bool:
+        """Wait for all queued post-commit work (event publish) to run."""
+        return self._post_commit.flush(timeout_s)
+
+    def stop(self) -> None:
+        self._post_commit.stop()
+
     # --- applying a decided block (reference: state/execution.go:131-209) --
 
-    def apply_block(self, state: State, block_id: BlockID, block: Block) -> tuple[State, int]:
+    def apply_block(self, state: State, block_id: BlockID, block: Block,
+                    commit_pending: SpeculativeCommitVerify | None = None,
+                    ) -> tuple[State, int]:
         import time as _t
 
         from tendermint_tpu.utils import metrics as tmmetrics
 
         _started = _t.monotonic()
-        self.validate_block(state, block)
+        self.validate_block(state, block, commit_pending=commit_pending)
 
         abci_responses = self._exec_block_on_app(state, block)
         self.store.save_abci_responses(block.header.height, abci_responses)
@@ -111,7 +338,13 @@ class BlockExecutor:
         new_state = replace(new_state, app_hash=app_hash)
         self.store.save(new_state)
 
-        self._fire_events(block, block_id, abci_responses, validator_updates)
+        # Post-commit work is off the critical path: apply_block returns
+        # as soon as state is durably saved; the single FIFO worker keeps
+        # height h's events ahead of h+1's for every subscriber.
+        if self.event_bus is not None:
+            self._post_commit.submit(
+                lambda: self._fire_events(block, block_id, abci_responses,
+                                          validator_updates))
         if tmmetrics.GLOBAL_NODE_METRICS is not None:
             tmmetrics.GLOBAL_NODE_METRICS.block_processing_time.observe(
                 _t.monotonic() - _started)
@@ -131,13 +364,8 @@ class BlockExecutor:
             last_commit_info=commit_info,
             byzantine_validators=byz_vals,
         ))
-        deliver_txs = []
-        invalid_count = 0
-        for tx in block.data.txs:
-            res = self.app.deliver_tx(abci.RequestDeliverTx(tx=tx))
-            if not res.is_ok():
-                invalid_count += 1
-            deliver_txs.append(res)
+        deliver_txs = deliver_block_txs(self.app, block.data.txs)
+        _observe_invalid_txs(sum(1 for r in deliver_txs if not r.is_ok()))
         end_res = self.app.end_block(abci.RequestEndBlock(height=block.header.height))
         return ABCIResponses(deliver_txs=deliver_txs, end_block=end_res, begin_block=begin_res)
 
@@ -171,6 +399,13 @@ class BlockExecutor:
             return
         from tendermint_tpu.types import events
 
+        with _trace.current().span("apply.post_commit",
+                                   height=block.header.height):
+            self._publish_events(block, block_id, abci_responses,
+                                 validator_updates, events)
+
+    def _publish_events(self, block, block_id, abci_responses,
+                        validator_updates, events) -> None:
         self.event_bus.publish_event_new_block(
             events.EventDataNewBlock(block=block, block_id=block_id,
                                      result_begin_block=abci_responses.begin_block,

@@ -11,10 +11,18 @@ class BlockValidationError(Exception):
     pass
 
 
-def validate_block(state: State, block: Block, block_store=None) -> None:
+def validate_block(state: State, block: Block, block_store=None,
+                   commit_pending=None) -> None:
     """reference: state/validation.go:15. Includes the batched
     LastValidators.VerifyCommit at the same point the reference does (line 93),
-    which on TPU is one kernel launch instead of N serial verifies."""
+    which on TPU is one kernel launch instead of N serial verifies.
+
+    `commit_pending` (a resolvable handle from
+    BlockExecutor.dispatch_commit_verify, already stale-checked by the
+    caller) replaces the synchronous verify with a resolve of the
+    already-dispatched device work — the commit→apply overlap seam
+    (docs/EXECUTION.md). Resolution replays the exact serial accept/reject
+    decision, so accept/reject and error attribution are unchanged."""
     block.validate_basic()
 
     h = block.header
@@ -58,6 +66,11 @@ def validate_block(state: State, block: Block, block_store=None) -> None:
     if block.header.height == state.initial_height:
         if block.last_commit is not None and len(block.last_commit.signatures) != 0:
             raise BlockValidationError("initial block can't have LastCommit signatures")
+    elif commit_pending is not None:
+        # dispatched earlier (overlapped with store save / WAL fsync);
+        # resolve() is idempotent and raises exactly what the
+        # synchronous verify would
+        commit_pending.resolve()
     else:
         # THE hot call (reference: state/validation.go:93): one batched kernel.
         state.last_validators.verify_commit(

@@ -15,7 +15,19 @@ def tx_key(tx: bytes) -> bytes:
 
 
 def txs_hash(txs: list[bytes]) -> bytes:
-    """Merkle root over per-tx hashes (reference: types/tx.go:47-55)."""
+    """Merkle root over per-tx hashes (reference: types/tx.go:47-55).
+
+    The per-tx leaves route through ops/chash.sha256_many when the C
+    library is up, so a full block's tx hashing pays one FFI crossing
+    instead of N hashlib calls — bit-identical either way (tmhash.sum IS
+    SHA-256)."""
+    if len(txs) > 1:
+        from tendermint_tpu.ops import chash
+
+        if chash.available():
+            digests = chash.sha256_many(list(txs))
+            return merkle.hash_from_byte_slices(
+                [digests[i].tobytes() for i in range(len(txs))])
     return merkle.hash_from_byte_slices([tx_hash(t) for t in txs])
 
 

@@ -103,6 +103,12 @@ CANONICAL_SITES: dict[str, str] = {
     "mempool.ingest": "one batched CheckTx dispatch of the ingestion front "
                       "door (mempool check_tx_batch + the batched recheck); "
                       "failures degrade to the serial per-tx CheckTx loop",
+    "abci.deliver_batch": "one batched DeliverTx chunk dispatch of the "
+                          "execution plane (state/execution.py "
+                          "deliver_block_txs); fires BEFORE the dispatch, "
+                          "so an injected failure degrades that chunk to "
+                          "the serial per-tx DeliverTx loop without "
+                          "double-applying any tx",
     "ops.ed25519.device": "ed25519 batch-verifier device dispatch; failures "
                           "trip the circuit breaker onto the host fallback",
     "ops.sr25519.device": "sr25519 batch-verifier device dispatch (twin "

@@ -228,6 +228,16 @@ class NodeMetrics:
             "state", "block_processing_time",
             "Time spent processing a block (ApplyBlock).",
             buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2, 5))
+        # batched execution plane (state/execution.py, docs/EXECUTION.md)
+        self.deliver_batch_size = r.histogram(
+            "abci", "deliver_batch_size",
+            "Txs per batched DeliverTx chunk dispatch through the shared "
+            "deliver engine (state/execution.py deliver_block_txs).",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048))
+        self.abci_deliver_tx_invalid_total = r.counter(
+            "abci", "deliver_tx_invalid_total",
+            "DeliverTx responses with a non-OK code in applied blocks "
+            "(txs that were committed but rejected by the app).")
         # mempool
         self.mempool_size = r.gauge("mempool", "size", "Number of uncommitted txs.")
         self.mempool_failed_txs = r.counter("mempool", "failed_txs", "Rejected txs.")
@@ -344,6 +354,8 @@ class NodeMetrics:
         # histogram scrapes explicit zeros like the phase histogram
         self.ingest_batch_size.seed()
         self.ingest_coalesced.add(0.0)
+        self.deliver_batch_size.seed()
+        self.abci_deliver_tx_invalid_total.add(0.0)
         for result in ("ok", "reject", "shed"):
             self.ingest_txs.add(0.0, result=result)
         # evidence rejections: closed reason universe (types/evidence.py

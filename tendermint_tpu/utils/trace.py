@@ -88,6 +88,13 @@ CANONICAL_SPANS = {
                            "ingest coalescer",
     "p2p.send": "message queued to a peer channel (mark)",
     "p2p.recv": "message delivered to a reactor (span over on_receive)",
+    # batched execution plane (state/execution.py, docs/EXECUTION.md)
+    "abci.deliver_txs": "all DeliverTx work of one block through the "
+                        "shared deliver engine (span; n= txs)",
+    "abci.deliver_batch": "one batched ABCI DeliverTxBatch chunk dispatch "
+                          "(span; n= txs)",
+    "apply.post_commit": "post-commit event publish of one height on the "
+                         "async worker (span; height= tag)",
     # self-healing storage plane (store/scrub.py, store/repair.py)
     "store.scrub": "one integrity-scrub pass over a node's stores (span)",
     "store.repair": "peer re-fetch + batch-verified rewrite of one damaged "
@@ -101,7 +108,8 @@ MIRRORED_SPANS = (
     "verify.host_prep", "verify.queue", "verify.readback", "verify.replay",
     "verify.shard_dispatch", "consensus.vote_drain", "consensus.store_save",
     "consensus.abci_apply", "mempool.check_tx", "mempool.ingest_batch",
-    "mempool.ingest_wait",
+    "mempool.ingest_wait", "abci.deliver_txs", "abci.deliver_batch",
+    "apply.post_commit",
 )
 _MIRROR_SET = frozenset(MIRRORED_SPANS)
 
