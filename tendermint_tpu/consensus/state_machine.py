@@ -50,6 +50,7 @@ from tendermint_tpu.types.vote import (
     Vote,
 )
 from tendermint_tpu.types.vote_set import VoteSet
+from tendermint_tpu.utils import clock as tmclock
 from tendermint_tpu.utils import peerscore
 from tendermint_tpu.utils import trace as _trace
 
@@ -164,8 +165,14 @@ class ConsensusState:
 
     def __init__(self, config: ConsensusConfig, state, block_exec, block_store,
                  mempool=None, evidence_pool=None, priv_validator=None,
-                 event_bus=None, wal: WAL | None = None, logger=None):
+                 event_bus=None, wal: WAL | None = None, logger=None,
+                 clock=None):
         self.config = config
+        # per-node time source (utils/clock.py, docs/NEMESIS.md): every
+        # wall-clock read consensus makes — proposal/vote/commit timestamps,
+        # round-0 scheduling, WAL frame times — goes through this clock so
+        # a chaos harness can skew one fabric node without touching the host
+        self.clock = clock if clock is not None else tmclock.DEFAULT
         self.block_exec = block_exec
         self.block_store = block_store
         self.mempool = mempool
@@ -193,7 +200,7 @@ class ConsensusState:
         self._msg_queue = peerscore.ShedQueue(maxsize=1000,
                                               on_shed=self._count_shed)
         self._internal_queue: queue.Queue = queue.Queue(maxsize=1000)
-        self._ticker = TimeoutTicker(self._on_timeout_fired)
+        self._ticker = TimeoutTicker(self._on_timeout_fired, clock=self.clock)
         self._timeout_queue: queue.Queue = queue.Queue()
         self._mtx = threading.RLock()
         self._holdover: object | None = None  # non-vote msg dequeued mid-drain
@@ -271,7 +278,7 @@ class ConsensusState:
             # Empty WAL gets a height-0 end marker so crash replay works for
             # the very first height (reference: consensus/wal.go OnStart).
             if next(iter(self.wal.iter_messages()), None) is None:
-                self.wal.write_sync(EndHeightMessage(0), _time.time_ns())
+                self.wal.write_sync(EndHeightMessage(0), self.clock.now_ns())
             self._catchup_replay(self.rs.height)
         self._running = True
         if self._thread is not None and self._thread.is_alive():
@@ -735,7 +742,7 @@ class ConsensusState:
         if self.rs.step == STEP_NEW_HEIGHT:
             if self._need_proof_block(self.rs.height):
                 return
-            remain = max(self.rs.start_time.unix_ns() - _time.time_ns(), 0) / 1e9
+            remain = max(self.rs.start_time.unix_ns() - self.clock.now_ns(), 0) / 1e9
             self._schedule_timeout(remain + 0.001, self.rs.height, 0, STEP_NEW_ROUND)
         elif self.rs.step == STEP_NEW_ROUND:
             self._enter_propose(self.rs.height, 0)
@@ -771,7 +778,7 @@ class ConsensusState:
         rs.height = height
         rs.round = 0
         rs.step = STEP_NEW_HEIGHT
-        now_ns = _time.time_ns()
+        now_ns = self.clock.now_ns()
         base_ns = rs.commit_time.unix_ns() if not rs.commit_time.is_zero() else now_ns
         rs.start_time = Time.from_unix_ns(base_ns + int(self.config.commit_time_s() * 1e9))
         rs.validators = validators
@@ -796,7 +803,7 @@ class ConsensusState:
             self.wal.write(
                 WALMessageBlob("round_state", b"%d/%d/%d" % (
                     self.rs.height, self.rs.round, self.rs.step)),
-                _time.time_ns(),
+                self.clock.now_ns(),
             )
         self._n_steps += 1
         # step-duration tracing (no-op beyond the enabled attribute check +
@@ -830,7 +837,7 @@ class ConsensusState:
 
     def _schedule_round_0(self) -> None:
         """reference: consensus/state.go:522-530."""
-        sleep = max(self.rs.start_time.unix_ns() - _time.time_ns(), 0) / 1e9
+        sleep = max(self.rs.start_time.unix_ns() - self.clock.now_ns(), 0) / 1e9
         self._schedule_timeout(sleep, self.rs.height, 0, STEP_NEW_HEIGHT)
 
     # --- ENTER: transitions -------------------------------------------------
@@ -928,7 +935,8 @@ class ConsensusState:
             self.wal.flush_and_sync()
         prop_block_id = BlockID(hash=block.hash(), part_set_header=block_parts.header())
         proposal = Proposal(height=height, round=round_, pol_round=rs.valid_round,
-                            block_id=prop_block_id, timestamp=Time.now())
+                            block_id=prop_block_id,
+                            timestamp=Time.from_unix_ns(self.clock.now_ns()))
         try:
             self.priv_validator.sign_proposal(self.state.chain_id, proposal)
         except Exception as e:  # noqa: BLE001 - failed signing is non-fatal
@@ -1139,7 +1147,7 @@ class ConsensusState:
 
         rs.step = STEP_COMMIT
         rs.commit_round = commit_round
-        rs.commit_time = Time.now()
+        rs.commit_time = Time.from_unix_ns(self.clock.now_ns())
         self._new_step()
         self._try_finalize_commit(height)
 
@@ -1189,7 +1197,7 @@ class ConsensusState:
         # crash site 2 (reference: state.go:1619)
         faults.fail_point("consensus.finalize.end_height")
         if self.wal is not None:
-            self.wal.write_sync(EndHeightMessage(height), _time.time_ns())
+            self.wal.write_sync(EndHeightMessage(height), self.clock.now_ns())
 
         # crash site 3 (reference: state.go:1642)
         faults.fail_point("consensus.finalize.apply_block")
@@ -1413,7 +1421,7 @@ class ConsensusState:
 
     def _vote_time(self) -> Time:
         """BFT time monotonicity (reference: consensus/state.go:2216-2234)."""
-        now = Time.now()
+        now = Time.from_unix_ns(self.clock.now_ns())
         min_vote_time = now
         time_iota_ns = self.state.consensus_params.block.time_iota_ms * 1_000_000
         if self.rs.locked_block is not None:

@@ -26,8 +26,12 @@ class TimeoutTicker:
     pending one; stale timeouts (older height/round/step) are ignored at
     schedule time (reference: consensus/ticker.go:100-134)."""
 
-    def __init__(self, callback):
+    def __init__(self, callback, clock=None):
         self._callback = callback
+        # per-node time source (utils/clock.py): the clock's rate scales
+        # every scheduled duration, so a skew-rate nemesis can make one
+        # node's round timeouts run fast or slow relative to the mesh
+        self._clock = clock
         self._timer: threading.Timer | None = None
         self._current: TimeoutInfo | None = None
         self._mtx = threading.Lock()
@@ -45,7 +49,9 @@ class TimeoutTicker:
             if self._timer is not None:
                 self._timer.cancel()
             self._current = ti
-            self._timer = threading.Timer(ti.duration_s, self._fire, args=(ti,))
+            delay = (ti.duration_s if self._clock is None
+                     else self._clock.timer_duration(ti.duration_s))
+            self._timer = threading.Timer(delay, self._fire, args=(ti,))
             self._timer.daemon = True
             self._timer.start()
 

@@ -1,0 +1,367 @@
+"""Hour-scale soak campaigns with minimized repros (docs/SOAK.md §campaigns;
+reference: the nightly e2e rotation of test/e2e/ — but one budgeted RUN
+composing seeded phases over the ENTIRE adversary vocabulary, emitting a
+tracked coverage artifact, and delta-debugging any failure down to a
+minimal replayable schedule).
+
+A campaign is a sequence of independent soak PHASES under one wall-clock
+budget (``TMTPU_CAMPAIGN_BUDGET_S``): each phase builds a fresh durable
+cluster, runs one seeded :func:`~tendermint_tpu.e2e.soak.run_soak`-style
+schedule under the continuous auditor, and tears down. Phase seeds derive
+from the campaign seed, so the whole campaign replays from ONE knob set —
+the phase boundary is also the isolation boundary: a violation is
+attributed to exactly one phase schedule, which is what makes the
+minimizer's job finite.
+
+**Coverage census.** The campaign tracks which action kinds its schedules
+have composed so far and biases later phases toward the uncovered rest of
+the vocabulary (seeded gap-fill injection, so the bias is replayable):
+a budget long enough to run a handful of phases provably exercises every
+adversary plane — partitions, link faults, floods, churn, power changes,
+equivocation, byzantine roles, bit rot, power-loss crashes, crash storms,
+and clock skew — and the emitted artifact proves it with per-kind counts.
+
+**The artifact** (``SOAK_r01.json`` at the repo root; schema below) is the
+campaign's durable output: coverage census, per-phase stats, commit and
+audit totals, and — on failure — the violation list with phase
+attribution plus the auto-minimized repro line::
+
+    {"version": 1, "seed": ..., "budget_s": ..., "elapsed_s": ...,
+     "nodes": ..., "phases": [{"phase": 0, "seed": ..., "schedule": ...,
+     "duration_s": ..., "max_height": ..., "heights_audited": ...,
+     "txs_submitted": ..., "actions_fired": ..., "violations": [...]}],
+     "coverage": {"partition": 2, "crash": 1, ...},
+     "stats": {"heights_audited": ..., "txs_submitted": ...,
+     "actions_fired": ..., "max_height": ...},
+     "violations": [{"phase": 0, "kind": "liveness", "detail": ...}],
+     "repro": "TMTPU_SOAK_REPRO: ...", "minimized_repro": "..."}
+
+**Repro minimization.** On the first violating phase the campaign stops
+and delta-debugs (classic ddmin over the ``;``-separated schedule
+entries): seeded subsets of the failing schedule re-run against the
+recorded violation signature (the violation KIND) until no strictly
+smaller subset still reproduces it. The result is printed and recorded as
+a one-line ``TMTPU_SOAK_REPRO`` an engineer replays directly — a
+ten-entry storm schedule that fails because one never-rebooted quorum
+crash minimizes to that single crash entry.
+
+Knobs (all in docs/CONFIG.md): ``TMTPU_CAMPAIGN_SEED``,
+``TMTPU_CAMPAIGN_BUDGET_S``, ``TMTPU_CAMPAIGN_PHASE_S``,
+``TMTPU_CAMPAIGN_NODES``, ``TMTPU_CAMPAIGN_OUT``,
+``TMTPU_CAMPAIGN_MINIMIZE``. The campaign deliberately IGNORES the
+soak env overrides (``TMTPU_SOAK_SCHEDULE`` and friends) — phase
+schedules are the campaign's to derive; the soak knobs configure
+single soaks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+
+from tendermint_tpu.e2e.fabric import Cluster
+from tendermint_tpu.e2e.soak import (SoakAction, SoakDriver, SoakSchedule,
+                                     repro_line)
+from tendermint_tpu.utils import faults, nemesis
+
+SCHEMA_VERSION = 1
+DEFAULT_BUDGET_S = 120.0
+DEFAULT_PHASE_S = 25.0
+DEFAULT_NODES = 6
+
+# the vocabulary a campaign drives coverage over: every soak kind that
+# composes against a fixed-size durable cluster (leave shrinks the
+# validator set for good and join_statesync needs the rpc+snapshot
+# serving config, so both stay opt-in via explicit phase schedules)
+VOCABULARY = ("partition", "linkfault", "flood", "join", "power",
+              "restart", "evidence", "byz", "bitrot", "crash",
+              "crashstorm", "skew")
+
+
+def _violation_kind(v: str) -> str:
+    """``"[liveness @12.3s] detail"`` -> ``"liveness"`` (the minimizer's
+    failure signature; Violation.__str__ is the only wire format the
+    report keeps)."""
+    v = str(v)
+    if v.startswith("["):
+        return v[1:].split("@")[0].strip()
+    return "unknown"
+
+
+def _gap_action(kind: str, at_s: float, target: int) -> SoakAction | None:
+    """A deterministic single action of ``kind`` for coverage gap-fill.
+    The injected crash always tears the WAL tail — the campaign's
+    guarantee that torn-tail recovery is exercised, not left to the
+    generator's coin."""
+    t = round(at_s, 1)
+    if kind == "partition":
+        return SoakAction(t, kind, f"{target}|rest", 1.5)
+    if kind == "linkfault":
+        return SoakAction(t, kind, f"*>{target}:drop%0.5", 1.5)
+    if kind == "flood":
+        return SoakAction(t, kind, f"0>{target}", 1.0)
+    if kind == "join":
+        return SoakAction(t, kind)
+    if kind == "power":
+        return SoakAction(t, kind, f"{target}:15")
+    if kind == "restart":
+        return SoakAction(t, kind, str(target))
+    if kind == "evidence":
+        return SoakAction(t, kind, str(target))
+    if kind == "byz":
+        return SoakAction(t, kind, f"{target}:double_prevote")
+    if kind == "bitrot":
+        return SoakAction(t, kind, f"{target}:block:bitrot")
+    if kind == "crash":
+        return SoakAction(t, kind, f"{target}:torn", 2.0)
+    if kind == "crashstorm":
+        return SoakAction(t, kind, "1", 2.0)
+    if kind == "skew":
+        return SoakAction(t, kind, f"{target}:120", 5.0)
+    return None
+
+
+def fill_gaps(schedule: SoakSchedule, covered, duration_s: float,
+              seed: int, nodes: int, max_inject: int = 3) -> SoakSchedule:
+    """Bias a generated phase toward the campaign's uncovered vocabulary:
+    inject up to ``max_inject`` seeded actions for kinds neither covered
+    by an earlier phase nor present in this schedule. Deterministic in
+    (seed, covered): a replayed campaign re-derives the same census at
+    each phase boundary and therefore the same injections."""
+    have = set(covered) | {a.kind for a in schedule.actions}
+    missing = [k for k in VOCABULARY if k not in have][:max_inject]
+    if not missing:
+        return schedule
+    rng = random.Random(f"campaign-gaps:{seed}")
+    actions = list(schedule.actions)
+    for i, kind in enumerate(missing):
+        at = duration_s * (0.2 + 0.55 * (i + 1) / (len(missing) + 1))
+        a = _gap_action(kind, at, rng.randrange(1, nodes))
+        if a is not None:
+            actions.append(a)
+    return SoakSchedule(actions)
+
+
+# --- repro minimization (ddmin) ----------------------------------------------
+
+
+def minimize(entries: list[str], run_fn, max_runs: int = 24):
+    """Classic delta debugging over schedule entries: find a 1-minimal
+    subset for which ``run_fn(subset) -> True`` (the failure signature
+    still reproduces). ``run_fn`` owns re-running the soak — injected so
+    the algorithm is unit-testable without clusters — and the run budget
+    is capped: each probe is a full seeded soak, so an un-capped ddmin on
+    a long schedule could cost more than the campaign it serves. Returns
+    ``(subset, runs_used)``; on a cap hit the best-so-far subset (always
+    still failing) is returned."""
+    runs = 0
+
+    def probe(subset: list[str]) -> bool:
+        nonlocal runs
+        if runs >= max_runs:
+            return False
+        runs += 1
+        return bool(run_fn(subset))
+
+    cur = list(entries)
+    n = 2
+    while len(cur) >= 2 and runs < max_runs:
+        chunk = max(1, (len(cur) + n - 1) // n)
+        subsets = [cur[i:i + chunk] for i in range(0, len(cur), chunk)]
+        reduced = False
+        for i in range(len(subsets)):
+            comp = [e for j, s in enumerate(subsets) if j != i for e in s]
+            if comp and len(comp) < len(cur) and probe(comp):
+                cur = comp
+                n = max(n - 1, 2)
+                reduced = True
+                break
+        if not reduced:
+            if n >= len(cur):
+                break
+            n = min(len(cur), n * 2)
+    return cur, runs
+
+
+# --- the campaign loop --------------------------------------------------------
+
+
+def _run_phase(root: str, spec: str, seed: int, nodes: int, topology: str,
+               duration_s: float, liveness_budget_s: float, logger=None):
+    """One isolated phase: fresh durable cluster, one schedule, the
+    continuous auditor, full teardown. Bypasses run_soak's env overrides
+    on purpose (module docstring: the campaign owns its phase knobs)."""
+    os.makedirs(root, exist_ok=True)
+    faults.configure([], seed=faults.REGISTRY.seed or 2026)
+    cluster = Cluster(
+        root, nodes, topology=topology, durable=True,
+        trace=os.environ.get("TMTPU_TRACE", "1") != "0", logger=logger)
+    cluster.start()
+    try:
+        driver = SoakDriver(cluster, SoakSchedule.parse(spec), seed,
+                            duration_s, liveness_budget_s=liveness_budget_s,
+                            logger=logger)
+        return driver.run()
+    finally:
+        cluster.stop()
+        nemesis.clear()
+
+
+def run_campaign(root: str, seed: int = 1, budget_s: float = DEFAULT_BUDGET_S,
+                 phase_s: float = DEFAULT_PHASE_S, nodes: int = DEFAULT_NODES,
+                 topology: str = "full", out: str = "",
+                 minimize_on_violation: bool = True,
+                 max_minimize_runs: int = 8,
+                 liveness_budget_s: float = 30.0,
+                 phase_specs: list[str] | None = None,
+                 logger=None) -> dict:
+    """Run seeded soak phases until the budget is spent (always at least
+    one), stop at the first violating phase, minimize its schedule, and
+    return (and optionally write) the campaign artifact.
+
+    ``phase_specs`` pins explicit phase schedules (cycled) instead of
+    seeded generation — the deterministic form CI stages use; generation
+    plus gap-fill is the hour-scale soak form. Env overrides:
+    ``TMTPU_CAMPAIGN_SEED``, ``TMTPU_CAMPAIGN_BUDGET_S``,
+    ``TMTPU_CAMPAIGN_PHASE_S``, ``TMTPU_CAMPAIGN_NODES``,
+    ``TMTPU_CAMPAIGN_OUT``, ``TMTPU_CAMPAIGN_MINIMIZE``."""
+    seed = int(os.environ.get("TMTPU_CAMPAIGN_SEED", seed))
+    budget_s = float(os.environ.get("TMTPU_CAMPAIGN_BUDGET_S", budget_s))
+    phase_s = float(os.environ.get("TMTPU_CAMPAIGN_PHASE_S", phase_s))
+    nodes = int(os.environ.get("TMTPU_CAMPAIGN_NODES", nodes))
+    out = os.environ.get("TMTPU_CAMPAIGN_OUT", out)
+    minimize_on_violation = os.environ.get(
+        "TMTPU_CAMPAIGN_MINIMIZE",
+        "1" if minimize_on_violation else "") == "1"
+    t0 = time.monotonic()
+    phases: list[dict] = []
+    coverage: dict[str, int] = {}
+    violations: list[dict] = []
+    repro = minimized = ""
+    i = 0
+    while True:
+        elapsed = time.monotonic() - t0
+        if i > 0 and budget_s - elapsed < phase_s * 0.5:
+            break  # not enough budget for a meaningful next phase
+        dur = max(8.0, min(phase_s, budget_s - elapsed if i else phase_s))
+        phase_seed = seed * 1000 + i
+        if phase_specs:
+            spec = phase_specs[i % len(phase_specs)]
+        else:
+            sched = SoakSchedule.generate(phase_seed, dur, nodes,
+                                          durable=True)
+            spec = fill_gaps(sched, coverage, dur, phase_seed,
+                             nodes).describe()
+        if logger:
+            logger.info("campaign phase", phase=i, schedule=spec)
+        p0 = time.monotonic()
+        rep = _run_phase(os.path.join(root, f"phase_{i:02d}"), spec,
+                         phase_seed, nodes, topology, dur,
+                         liveness_budget_s, logger=logger)
+        for a in SoakSchedule.parse(spec).actions:
+            coverage[a.kind] = coverage.get(a.kind, 0) + 1
+        phases.append({
+            "phase": i, "seed": phase_seed, "schedule": spec,
+            "duration_s": dur, "elapsed_s": round(time.monotonic() - p0, 1),
+            "max_height": max(rep.heights.values(), default=0),
+            "heights_audited": rep.heights_audited,
+            "txs_submitted": rep.txs_submitted,
+            "actions_fired": rep.actions_fired,
+            "violations": list(rep.violations),
+        })
+        for v in rep.violations:
+            violations.append({"phase": i, "kind": _violation_kind(v),
+                               "detail": str(v)})
+        if rep.violations:
+            repro = rep.repro
+            if minimize_on_violation:
+                minimized = _minimize_phase(
+                    root, spec, phase_seed, nodes, topology, dur,
+                    liveness_budget_s, _violation_kind(rep.violations[0]),
+                    max_minimize_runs, logger=logger)
+            break  # a campaign's job on failure is the minimized repro
+        i += 1
+    artifact = {
+        "version": SCHEMA_VERSION,
+        "seed": seed, "budget_s": budget_s, "phase_s": phase_s,
+        "nodes": nodes, "topology": topology,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+        "phases": phases,
+        "coverage": {k: coverage[k] for k in sorted(coverage)},
+        "stats": {
+            "heights_audited": sum(p["heights_audited"] for p in phases),
+            "txs_submitted": sum(p["txs_submitted"] for p in phases),
+            "actions_fired": sum(p["actions_fired"] for p in phases),
+            "max_height": max((p["max_height"] for p in phases), default=0),
+        },
+        "violations": violations,
+        "repro": repro,
+        "minimized_repro": minimized,
+    }
+    if out:
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(artifact, f, indent=1, sort_keys=False)
+            f.write("\n")
+    if minimized:
+        print(minimized)
+    return artifact
+
+
+def _minimize_phase(root: str, spec: str, seed: int, nodes: int,
+                    topology: str, duration_s: float,
+                    liveness_budget_s: float, signature: str,
+                    max_runs: int, logger=None) -> str:
+    """Delta-debug a failing phase schedule against its violation KIND.
+    Each probe re-runs a seeded soak over the entry subset in a fresh
+    home; probe durations shrink with the subset (last action time plus a
+    detection window), so minimization gets cheaper as it converges."""
+    probes = {"n": 0}
+
+    def run_fn(entries: list[str]) -> bool:
+        probes["n"] += 1
+        sub = ";".join(entries)
+        last_at = max((SoakAction.parse(e).at_s for e in entries),
+                      default=0.0)
+        dur = min(duration_s, last_at + liveness_budget_s + 12.0)
+        try:
+            rep = _run_phase(
+                os.path.join(root, f"minimize_{probes['n']:02d}"), sub,
+                seed, nodes, topology, dur, liveness_budget_s,
+                logger=logger)
+        except Exception:  # noqa: BLE001 - a probe that cannot even run
+            return False   # does not reproduce the recorded signature
+        return any(_violation_kind(v) == signature for v in rep.violations)
+
+    entries = [e for e in spec.split(";") if e.strip()]
+    subset, runs = minimize(entries, run_fn, max_runs=max_runs)
+    if logger:
+        logger.info("campaign minimized", entries=len(entries),
+                    kept=len(subset), probes=runs)
+    return repro_line(seed, nodes, topology, duration_s,
+                      ";".join(subset), durable=True)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import tempfile
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S)
+    ap.add_argument("--phase", type=float, default=DEFAULT_PHASE_S)
+    ap.add_argument("--nodes", type=int, default=DEFAULT_NODES)
+    ap.add_argument("--out", default="SOAK_r01.json")
+    args = ap.parse_args(argv)
+    with tempfile.TemporaryDirectory(prefix="tmtpu-campaign-") as root:
+        artifact = run_campaign(root, seed=args.seed, budget_s=args.budget,
+                                phase_s=args.phase, nodes=args.nodes,
+                                out=args.out)
+    print(json.dumps(artifact["stats"], indent=1))
+    print(f"coverage: {sorted(artifact['coverage'])}")
+    return 0 if not artifact["violations"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -275,6 +275,9 @@ class Cluster:
         self.trace = trace
         self.logger = logger
         self.nodes: dict[int, FabricNode] = {}
+        # hard-killed incarnations awaiting reboot(): idx -> (priv, links)
+        # — the durable home under root/node<idx> is the crash artifact
+        self._crashed: dict[int, tuple] = {}
         self._next_idx = 0
         self._genesis = None
         self._privs: list = []
@@ -395,6 +398,7 @@ class Cluster:
             except Exception:  # noqa: BLE001 - teardown best effort
                 pass
         self.nodes.clear()
+        self._crashed.clear()
 
     # --- links --------------------------------------------------------------
 
@@ -611,6 +615,72 @@ class Cluster:
             if j != idx and j in self.nodes:
                 self.link(idx, j)
         return idx
+
+    def hard_kill(self, idx: int, tear: str | None = None,
+                  seed: int = 0) -> None:
+        """Power-cut a node (docs/SOAK.md ``crash`` action): sever every
+        link, abandon the incarnation via :meth:`Node.abort` — no flushes,
+        no WAL close, no sink drain — and leave the durable home exactly
+        as the crash instant left it. ``tear="torn"|"partial"`` then cuts
+        the WAL's final frame on the abandoned home
+        (``faults.tear_wal_tail``), the state a power cut mid-append
+        leaves. :meth:`reboot` boots a new incarnation from the home."""
+        fn = self.nodes.get(idx)
+        if fn is None:
+            raise KeyError(idx)
+        if not self.durable:
+            raise RuntimeError(
+                "hard_kill needs Cluster(durable=True): a memdb home dies "
+                "with the incarnation, leaving reboot() nothing to recover")
+        old_links = sorted(fn.links)
+        for j in old_links:
+            self.unlink(idx, j)
+        with self._lock:
+            self.nodes.pop(idx, None)
+        fn.node.abort()
+        if tear:
+            from tendermint_tpu.utils import faults
+
+            faults.tear_wal_tail(os.path.join(fn.home, "cs.wal"),
+                                 mode=tear, seed=seed)
+        self._crashed[idx] = (fn.priv, old_links)
+
+    def reboot(self, idx: int, links: int = 3) -> int:
+        """Boot a new incarnation of a hard-killed node from its abandoned
+        durable home: handshake replay + WAL repair/replay recover the
+        crash state, then consensus (or the stall watchdog's fast-sync
+        hand-off) catches the node up. The new FabricNode generation makes
+        the soak auditor re-verify the full prefix and exactly-once tx
+        application. Returns the node's (unchanged) index."""
+        crashed = self._crashed.pop(idx, None)
+        if crashed is None:
+            raise KeyError(f"node {idx} was not hard-killed")
+        priv, old_links = crashed
+        nfn = self._mk_node(idx, priv, fast_sync=False, joined_via="reboot")
+        with self._lock:
+            self.nodes[idx] = nfn
+        nfn.node.start()
+        for j in (old_links or sorted(self.nodes)[:links]):
+            if j != idx and j in self.nodes:
+                self.link(idx, j)
+        return idx
+
+    def set_skew(self, idx: int, skew_s: float) -> None:
+        """Skew one node's clock (docs/NEMESIS.md ``skew`` action): every
+        wall-clock read its consensus and evidence planes make shifts by
+        ``skew_s`` seconds; 0 restores host time."""
+        self.nodes[idx].node.clock.set_skew(skew_s)
+
+    def block_time(self, i: int, h: int):
+        """Header time of node ``i``'s block at height ``h`` (None when
+        missing/quarantined) — the BFT-time monotonicity audit's read."""
+        from tendermint_tpu.store.envelope import CorruptedStoreError
+
+        try:
+            meta = self.nodes[i].node.block_store.load_block_meta(h)
+        except CorruptedStoreError:
+            return None
+        return None if meta is None else meta.header.time
 
     def promote(self, idx: int, power: int, via: int | None = None) -> bytes:
         """Change a validator's voting power through the ABCI path: submit

@@ -20,6 +20,8 @@ from tendermint_tpu.e2e.runner import Manifest, Perturbation, PowerChange
 _VALIDATORS = (2, 3, 4, 5)
 _FASTSYNC = ("v0", "v0", "v1", "v2")  # v0 weighted: the default path
 _PERTURB_ACTIONS = ("kill", "restart", "pause", "partition")
+# Clock-skew dimension, seconds (negative = the node lives in the past).
+_CLOCK_SKEWS = (-90, -30, 45, 120, 600)
 # Byzantine behavior dimension (docs/BYZANTINE.md): derived from the
 # authoritative consensus/misbehavior.py catalog (minus the `absent`
 # alias) so a behavior added there enters the nightly matrix
@@ -71,6 +73,14 @@ def generate_one(rng: random.Random, index: int = 0) -> Manifest:
     if n_vals >= 4 and rng.random() < 0.33:
         byz = rng.randrange(n_vals)
         misbehavior = rng.choice(_BYZ_BEHAVIORS)
+    # Clock-skew dimension: one node runs with its whole time plane offset
+    # (TMTPU_CLOCK_SKEW_S). Needs >= 3 validators so the skewed timestamp
+    # stays a sub-1/3 voice in the BFT-time weighted median.
+    skewed = -1
+    skew_s = 0.0
+    if n_vals >= 3 and rng.random() < 0.25:
+        skewed = rng.randrange(n_vals)
+        skew_s = float(rng.choice(_CLOCK_SKEWS))
     return Manifest(
         validators=n_vals,
         chain_id=f"gen-{index}",
@@ -82,6 +92,8 @@ def generate_one(rng: random.Random, index: int = 0) -> Manifest:
         misbehavior=misbehavior,
         fastsync_version=rng.choice(_FASTSYNC),
         statesync_joiner=n_vals >= 3 and rng.random() < 0.25,
+        skewed_node=skewed,
+        clock_skew_s=skew_s,
     )
 
 

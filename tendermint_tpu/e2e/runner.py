@@ -142,6 +142,14 @@ class Manifest:
     fastsync_version: str = "v0"
     # Add a post-start state-sync joiner node (reference: statesync nodes).
     statesync_joiner: bool = False
+    # Clock-skew dimension (docs/SOAK.md): run `skewed_node`'s process with
+    # TMTPU_CLOCK_SKEW_S=clock_skew_s so its entire time plane — proposal
+    # timestamps, timeout ticker, evidence aging — is offset from the rest
+    # of the net. BFT time (weighted median) must absorb a sub-1/3 skewed
+    # voice: honest >2/3 keep committing and header times stay monotonic.
+    # -1 = no skewed node.
+    skewed_node: int = -1
+    clock_skew_s: float = 0.0
 
     @staticmethod
     def from_file(path: str) -> "Manifest":
@@ -229,6 +237,8 @@ class Runner:
                    os.environ.get("TMTPU_KVSTORE_SNAPSHOT_INTERVAL", "4")}
         if i == self.m.byzantine_node:
             env["TMTPU_MISBEHAVIOR"] = self.m.misbehavior
+        if i == self.m.skewed_node and self.m.clock_skew_s:
+            env["TMTPU_CLOCK_SKEW_S"] = str(self.m.clock_skew_s)
         log = open(os.path.join(self.workdir, f"node{i}.log"), "ab")
         return subprocess.Popen(
             [sys.executable, "-m", "tendermint_tpu.cli",

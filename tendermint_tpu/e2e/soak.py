@@ -44,6 +44,30 @@ seeded link decisions).
     @30:byz:5:double_precommit   full byzantine role: behavior spec on a node
     @33:byz:5:equivocate~8-12    height-windowed behavior map (misbehavior.py
                                  grammar; '+'-joined segments map behaviors)
+    @36:crash~3:2                power-loss hard-kill node 2, reboot after 3 s
+    @37:crash~3:4:torn           same, with a torn WAL tail on the dead home
+    @39:crash~-1:5               machine LOST: hard-killed, never rebooted
+    @42:crashstorm~3:2           hard-kill 2 seeded nodes at once, reboot all
+    @45:skew~5:3:120             skew node 3's clock +120 s for 5 s
+    @48:skew:3:-45               skew node 3 by -45 s for the rest of the run
+
+The ``crash``/``crashstorm`` actions need a DURABLE cluster
+(``Cluster(durable=True)``; ``run_soak(durable=True)`` /
+``TMTPU_SOAK_DURABLE=1``): a hard kill abandons the node object with no
+flush of any kind and a later reboot boots a NEW incarnation from the
+on-disk home exactly as the crash left it, so the home must outlive the
+process object (docs/SOAK.md crash cookbook). The downtime rides the
+duration slot; a NEGATIVE duration means the machine is never rebooted —
+the intentionally-unhealed form. Cutting quorum that way is a liveness
+violation BY DESIGN (the minimizer's forced-failure fixture); a crash
+whose survivors keep quorum, or one with a reboot pending, audits clean.
+``skew`` drives one node's patchable time source (utils/clock.py): the
+auditor then proves BFT time stays strictly monotone along the agreed
+prefix (header time is the weighted median of commit vote times, so a
+sub-1/3 skewed minority cannot bend it) and that no evidence is ever
+expired by wall-clock age alone (``false-expiry``: the pool requires
+BOTH the height bound and the duration bound to pass — block counts
+cannot be skewed).
 
 The ``byz`` action (and the legacy ``evidence`` shorthand) installs a
 consensus/misbehavior.py behavior spec on a node (docs/BYZANTINE.md) and
@@ -85,7 +109,12 @@ DEFAULT_DURATION_S = 20.0
 DEFAULT_TOPOLOGY = "k-regular:4"
 
 _KINDS = ("partition", "linkfault", "flood", "join", "join_statesync",
-          "power", "restart", "leave", "evidence", "bitrot", "byz")
+          "power", "restart", "leave", "evidence", "bitrot", "byz",
+          "crash", "crashstorm", "skew")
+
+# actions that only make sense against a durable cluster: a hard kill
+# abandons the live object and reboots from the on-disk home
+_DURABLE_KINDS = ("crash", "crashstorm")
 
 # the behaviors a seeded schedule cycles byzantine nodes through: derived
 # from the authoritative catalog (a behavior added there is exercised by
@@ -145,11 +174,15 @@ class SoakSchedule:
 
     @staticmethod
     def generate(seed: int, duration_s: float, nodes: int,
-                 statesync_ok: bool = False) -> "SoakSchedule":
+                 statesync_ok: bool = False,
+                 durable: bool = False) -> "SoakSchedule":
         """A deterministic composed-perturbation schedule. Partitions only
         ever cut a sub-1/3 minority (the majority keeps committing, so the
         liveness bound stays armed through them); churn actions target
-        joiners and high indices so genesis quorum is never destroyed."""
+        joiners and high indices so genesis quorum is never destroyed.
+        ``durable`` adds the power-loss vocabulary (crash/crashstorm):
+        generated crashes always reboot and never tear down more than a
+        sub-1/3 minority at once, so the audit stays armed through them."""
         rng = random.Random(f"soak:{seed}:{nodes}:{duration_s:g}")
         actions: list[SoakAction] = []
         joined = 0
@@ -164,9 +197,12 @@ class SoakSchedule:
         step = duration_s * 0.7 / slots
         t = duration_s * 0.15
         kinds = ["partition", "linkfault", "join", "power", "flood",
-                 "restart", "evidence", "bitrot", "byz"]
+                 "restart", "evidence", "bitrot", "byz", "skew"]
         if statesync_ok:
             kinds.append("join_statesync")
+        if durable:
+            # weight the crash plane like any other kind; storms stay rare
+            kinds += ["crash", "crash", "crashstorm"]
         for _ in range(slots):
             t += step * (0.6 + 0.8 * rng.random())
             if t >= duration_s * 0.9:
@@ -216,6 +252,26 @@ class SoakSchedule:
                     byz_cycle += 1
                     actions.append(SoakAction(round(t, 1), kind,
                                               f"{target}:{behavior}"))
+            elif kind == "crash":
+                # generated crashes ALWAYS reboot (positive downtime) and
+                # only one machine dies per action: the fault-free majority
+                # keeps committing, so the liveness audit stays armed
+                target = rng.randrange(nodes)
+                tear = rng.choice(("", "", ":torn", ":partial"))
+                actions.append(SoakAction(round(t, 1), kind,
+                                          f"{target}{tear}",
+                                          round(1.0 + 2.0 * rng.random(), 1)))
+            elif kind == "crashstorm":
+                # storm size capped at a sub-1/3 minority so the survivors
+                # keep quorum even while every victim is down at once
+                k = max(1, min((nodes - 1) // 3, 1 + rng.randrange(2)))
+                actions.append(SoakAction(round(t, 1), kind, str(k),
+                                          round(1.0 + 2.0 * rng.random(), 1)))
+            elif kind == "skew":
+                target = rng.randrange(nodes)
+                secs = rng.choice((-90, -30, 45, 120, 600))
+                actions.append(SoakAction(round(t, 1), kind,
+                                          f"{target}:{secs}", dur))
             elif kind == "bitrot":
                 # at-rest corruption of one node's storage plane: the
                 # scrubber must detect it and the repairer heal it with
@@ -234,6 +290,7 @@ class SoakSchedule:
 @dataclass
 class Violation:
     kind: str      # "fork" | "liveness" | "audit" | "evidence"
+                   # | "bft-time" | "false-expiry"
     detail: str
     at_s: float = 0.0
 
@@ -265,6 +322,15 @@ class ContinuousAuditor:
     others (a determinism bug in verification — the one detection
     machinery divergence a fork audit can't see). Both safety sweeps skip
     byzantine nodes: the promises are about the honest prefix.
+
+    Clock-skew invariants (the ``skew`` action's audit face): BFT time must
+    stay STRICTLY monotone along the agreed prefix — header time is the
+    weighted median of the commit's vote timestamps and validation pins it
+    above ``last_block_time``, so a sub-1/3 skewed minority must not be
+    able to bend it (kind ``bft-time``) — and no evidence pool may expire
+    evidence on wall-clock age alone: every entry in a pool's
+    ``expired_log`` must show the HEIGHT bound exceeded too, because block
+    counts cannot be skewed (kind ``false-expiry``).
     """
 
     def __init__(self, cluster: Cluster, liveness_budget_s: float = 30.0,
@@ -283,6 +349,12 @@ class ContinuousAuditor:
                                    DEFAULT_EVIDENCE_BOUND)))
         self._agreed: dict[int, bytes] = {}
         self._checked: dict[int, tuple[int, int]] = {}  # idx -> (node id(), h)
+        # BFT-time monotonicity books: height -> header time (Time) read
+        # once when the height is first agreed; flag set = reported once
+        self._agreed_t: dict[int, object] = {}
+        self._time_flagged: set[int] = set()
+        # false-expiry books: idx -> (gen key, # expired_log entries seen)
+        self._exp_scanned: dict[int, tuple] = {}
         # evidence lifecycle books: hash -> {idx: [commit heights]},
         # hash -> first commit height, plus flags so each anomaly reports
         # exactly once per (evidence, node)
@@ -404,6 +476,7 @@ class ContinuousAuditor:
                 if agreed is None:
                     self._agreed[h] = bh
                     self.heights_audited += 1
+                    self._check_bft_time(idx, h)
                 elif bh != agreed:
                     self._record("fork",
                                  f"height {h}: node {idx} committed "
@@ -412,6 +485,7 @@ class ContinuousAuditor:
             self._checked[idx] = (key, checked_to)
             best = max(best, tip)
         self._sweep_evidence(byz)
+        self._sweep_expiry(byz)
         now = time.monotonic()
         if best > self._best:
             self._best = best
@@ -428,6 +502,60 @@ class ContinuousAuditor:
                          f"(budget {self.liveness_budget_s:.0f}s) at "
                          f"height {self._best}"
                          + (f" [lagging: {lag}]" if lag else ""))
+
+    # --- clock-skew invariants (docs/SOAK.md skew cookbook) -----------------
+
+    def _check_bft_time(self, idx: int, h: int) -> None:
+        """Strict BFT-time monotonicity along the agreed prefix, read once
+        per height as it is first pinned (prefix agreement makes every
+        node's copy of h the SAME block, so one read suffices). Checked in
+        both directions because a statesync joiner can pin a high height
+        before any full node pins the one below it."""
+        read = getattr(self.cluster, "block_time", None)
+        if read is None:
+            return  # stub cluster (unit tests): no header times to audit
+        t = read(idx, h)
+        if t is None:
+            return  # meta not persisted yet / quarantined: next sweep
+        self._agreed_t[h] = t
+        for a, b in ((h - 1, h), (h, h + 1)):
+            ta, tb = self._agreed_t.get(a), self._agreed_t.get(b)
+            if (ta is not None and tb is not None and not tb > ta
+                    and b not in self._time_flagged):
+                self._time_flagged.add(b)
+                self._record(
+                    "bft-time",
+                    f"header time not strictly increasing: height {b} "
+                    f"time {tb} <= height {a} time {ta} (a skewed "
+                    f"proposer bent the weighted-median clock)")
+
+    def _sweep_expiry(self, byz: set) -> None:
+        """False-expiry audit: every entry a pool logs when it expires
+        evidence must show the HEIGHT bound exceeded too, not just the
+        wall-clock one — ages in blocks cannot be skewed, so a time-only
+        expiry means a skewed clock (or a pool bug) silently dropped
+        punishable evidence before its height window closed."""
+        for idx, fn in sorted(self.cluster.nodes.items()):
+            if idx in byz:
+                continue
+            pool = getattr(getattr(fn, "node", None), "evidence_pool", None)
+            log = getattr(pool, "expired_log", None)
+            if not log:
+                continue
+            key = (getattr(fn, "generation", None), id(fn.node))
+            prev_key, seen = self._exp_scanned.get(idx, (key, 0))
+            if prev_key != key:
+                seen = 0  # new incarnation logs from scratch
+            entries = list(log)
+            for e in entries[min(seen, len(entries)):]:
+                if e["age_blocks"] <= e["max_age_num_blocks"]:
+                    self._record(
+                        "false-expiry",
+                        f"node {idx} expired evidence from height "
+                        f"{e['height']} after only {e['age_blocks']} "
+                        f"blocks (limit {e['max_age_num_blocks']}): "
+                        f"expiry on wall-clock age alone")
+            self._exp_scanned[idx] = (key, len(entries))
 
     # --- evidence-lifecycle convergence (docs/BYZANTINE.md) -----------------
 
@@ -536,16 +664,19 @@ class SoakReport:
 
 
 def repro_line(seed: int, nodes: int, topology: str, duration_s: float,
-               schedule: str, statesync: bool = False) -> str:
+               schedule: str, statesync: bool = False,
+               durable: bool = False) -> str:
     """The single-line deterministic replay spec printed on any failure.
     Carries EVERY knob the run was built from — including the statesync
     flag, which implies the serving-node RPC + app-snapshot cluster
-    config a join_statesync action needs on replay."""
+    config a join_statesync action needs on replay, and the durable flag
+    the crash actions need (on-disk homes that outlive the node object)."""
     return (f"TMTPU_SOAK_REPRO: TMTPU_FAULT_SEED={faults.REGISTRY.seed} "
             f"TMTPU_SOAK_SEED={seed} TMTPU_SOAK_NODES={nodes} "
             f"TMTPU_SOAK_TOPOLOGY={topology} "
             f"TMTPU_SOAK_DURATION_S={duration_s:g} "
             + (f"TMTPU_SOAK_STATESYNC=1 " if statesync else "")
+            + (f"TMTPU_SOAK_DURABLE=1 " if durable else "")
             + f"TMTPU_SOAK_SCHEDULE='{schedule}'")
 
 
@@ -631,16 +762,22 @@ class SoakDriver:
         elif a.kind == "linkfault":
             src_dst, _, act = a.arg.partition(":")
             src, _, dst = src_dst.partition(">")
-            rule = self.cluster.add_link_rule(
-                src if src == "*" else int(src),
-                dst if dst == "*" else int(dst), act)
-            self._pending_heals.append(
-                (now + (a.dur_s or 2.0), "remove_rules", [rule]))
+            src = src if src == "*" else int(src)
+            dst = dst if dst == "*" else int(dst)
+            # a named endpoint may be mid-crash (hard-killed, reboot
+            # pending): a link fault against a dead machine is a no-op,
+            # not an error — same skip rule as every node-targeted action
+            if all(e == "*" or e in self.cluster.nodes for e in (src, dst)):
+                rule = self.cluster.add_link_rule(src, dst, act)
+                self._pending_heals.append(
+                    (now + (a.dur_s or 2.0), "remove_rules", [rule]))
         elif a.kind == "flood":
-            src, _, dst = a.arg.partition(">")
-            rule = self.cluster.add_link_rule(int(src), int(dst), "flood~4")
-            self._pending_heals.append(
-                (now + (a.dur_s or 1.0), "remove_rules", [rule]))
+            src_s, _, dst_s = a.arg.partition(">")
+            src, dst = int(src_s), int(dst_s)
+            if src in self.cluster.nodes and dst in self.cluster.nodes:
+                rule = self.cluster.add_link_rule(src, dst, "flood~4")
+                self._pending_heals.append(
+                    (now + (a.dur_s or 1.0), "remove_rules", [rule]))
         elif a.kind == "join":
             self.cluster.join_node(statesync=False)
         elif a.kind == "join_statesync":
@@ -677,6 +814,52 @@ class SoakDriver:
             mode = parts[2] if len(parts) > 2 else "bitrot"
             if idx in self.cluster.nodes:
                 self._apply_bitrot(self.cluster.nodes[idx], store, mode)
+        elif a.kind == "crash":
+            parts = a.arg.split(":")
+            tear = parts[1] if len(parts) > 1 else ""
+            self._crash([int(parts[0])], a.dur_s, now, tear=tear)
+        elif a.kind == "crashstorm":
+            rng = random.Random(f"soak-crash:{self.seed}:{self.fired}")
+            byz = getattr(self.cluster, "byzantine", set())
+            pool = [i for i in sorted(self.cluster.nodes) if i not in byz]
+            k = min(int(a.arg or "1"), max(len(pool) - 1, 0))
+            self._crash(rng.sample(pool, k) if k else [], a.dur_s, now)
+        elif a.kind == "skew":
+            idx_s, _, secs = a.arg.partition(":")
+            idx = int(idx_s)
+            if idx in self.cluster.nodes:
+                self.cluster.set_skew(idx, float(secs))
+                if a.dur_s > 0:
+                    self._pending_heals.append((now + a.dur_s, "unskew", idx))
+
+    def _crash(self, victims: list[int], downtime: float, now: float,
+               tear: str = "") -> None:
+        """Power-loss hard-kill of ``victims`` — no stop(), no flushes, the
+        durable home abandoned exactly as the crash left it — then, unless
+        the downtime is NEGATIVE (machine lost forever), staggered reboots
+        of new incarnations from those homes. Quorum arithmetic mirrors
+        partitions: losing quorum with reboots pending is an EXPECTED
+        stall (cleared when the last victim is back); losing it with a
+        never-reboot kill is a liveness violation by design."""
+        if not getattr(self.cluster, "durable", False):
+            raise RuntimeError(
+                "crash actions need a durable cluster "
+                "(run_soak(durable=True) / TMTPU_SOAK_DURABLE=1)")
+        victims = [i for i in victims if i in self.cluster.nodes]
+        if not victims:
+            return
+        rebooting = downtime >= 0
+        survivors = [i for i in self.cluster.nodes if i not in victims]
+        armed = rebooting and self._quorum_cut([survivors])
+        if armed:
+            self.auditor.expect_stall(True)
+        for n, idx in enumerate(victims):
+            self.cluster.hard_kill(idx, tear=tear or None,
+                                   seed=self.seed + self.fired)
+            if rebooting:
+                self._pending_heals.append(
+                    (now + (downtime or 3.0) + 0.3 * n, "reboot",
+                     (idx, armed and n == len(victims) - 1)))
 
     def _apply_bitrot(self, fn, store: str, mode: str) -> None:
         """At-rest corruption of one committed record on a live node, then
@@ -725,10 +908,18 @@ class SoakDriver:
                     # drop/delay/dup/flood never sever links, so no relink
                     for rule in payload:
                         nemesis.remove_link(rule)
+                elif what == "reboot":
+                    idx, armed = payload
+                    self.cluster.reboot(idx)
+                    if armed:  # last quorum-restoring reboot of the crash
+                        self.auditor.expect_stall(False)
+                elif what == "unskew":
+                    if payload in self.cluster.nodes:
+                        self.cluster.set_skew(payload, 0.0)
             except Exception as e:  # noqa: BLE001 - a failed relink is a
                 # finding, not a crashed soak: record it and keep driving
                 self.auditor._record("audit", f"{what} failed: {e}")
-                if what == "heal":
+                if what == "heal" or (what == "reboot" and payload[1]):
                     self.auditor.expect_stall(False)
 
     # --- the run loop -------------------------------------------------------
@@ -785,7 +976,9 @@ class SoakDriver:
         report.repro = repro_line(self.seed, self.cluster.n_initial,
                                   self.cluster.topology, self.duration_s,
                                   report.schedule,
-                                  statesync=self.cluster.rpc_node >= 0)
+                                  statesync=self.cluster.rpc_node >= 0,
+                                  durable=getattr(self.cluster, "durable",
+                                                  False))
         if not report.ok:
             print(report.repro)
         return report
@@ -794,13 +987,17 @@ class SoakDriver:
 def run_soak(root: str, seed: int = 1, nodes: int = DEFAULT_NODES,
              duration_s: float = DEFAULT_DURATION_S,
              topology: str = DEFAULT_TOPOLOGY, schedule_spec: str = "",
-             statesync_ok: bool = False, liveness_budget_s: float = 30.0,
+             statesync_ok: bool = False, durable: bool = False,
+             liveness_budget_s: float = 30.0,
              tweak=None, logger=None) -> SoakReport:
     """Build a cluster, run one seeded soak, tear down, report.
 
     Env overrides (the repro-line knobs): ``TMTPU_SOAK_SEED``,
     ``TMTPU_SOAK_NODES``, ``TMTPU_SOAK_TOPOLOGY``,
-    ``TMTPU_SOAK_DURATION_S``, ``TMTPU_SOAK_SCHEDULE``."""
+    ``TMTPU_SOAK_DURATION_S``, ``TMTPU_SOAK_SCHEDULE``,
+    ``TMTPU_SOAK_STATESYNC``, ``TMTPU_SOAK_DURABLE``. Durable mode gives
+    every node an on-disk home that survives hard kills — required by
+    (and implied in schedules containing) the crash/crashstorm actions."""
     seed = int(os.environ.get("TMTPU_SOAK_SEED", seed))
     nodes = int(os.environ.get("TMTPU_SOAK_NODES", nodes))
     topology = os.environ.get("TMTPU_SOAK_TOPOLOGY", topology)
@@ -808,12 +1005,18 @@ def run_soak(root: str, seed: int = 1, nodes: int = DEFAULT_NODES,
     schedule_spec = os.environ.get("TMTPU_SOAK_SCHEDULE", schedule_spec)
     statesync_ok = os.environ.get(
         "TMTPU_SOAK_STATESYNC", "1" if statesync_ok else "") == "1"
+    durable = os.environ.get(
+        "TMTPU_SOAK_DURABLE", "1" if durable else "") == "1"
     faults.configure([], seed=faults.REGISTRY.seed or 2026)
     schedule = (SoakSchedule.parse(schedule_spec) if schedule_spec
                 else SoakSchedule.generate(seed, duration_s, nodes,
-                                           statesync_ok=statesync_ok))
+                                           statesync_ok=statesync_ok,
+                                           durable=durable))
+    # a replayed schedule that contains crash actions implies durable homes
+    durable = durable or any(a.kind in _DURABLE_KINDS
+                             for a in schedule.actions)
     cluster = Cluster(
-        root, nodes, topology=topology,
+        root, nodes, topology=topology, durable=durable,
         snapshot_interval=4 if statesync_ok else 0,
         rpc_node=0 if statesync_ok else -1, tweak=tweak,
         # per-node flight recorders feed the auditor's last-phase stall
@@ -843,12 +1046,14 @@ def main(argv=None) -> int:
     ap.add_argument("--topology", default=DEFAULT_TOPOLOGY)
     ap.add_argument("--schedule", default="")
     ap.add_argument("--statesync", action="store_true")
+    ap.add_argument("--durable", action="store_true",
+                    help="on-disk node homes (enables crash/crashstorm)")
     args = ap.parse_args(argv)
     with tempfile.TemporaryDirectory(prefix="tmtpu-soak-") as root:
         report = run_soak(root, seed=args.seed, nodes=args.nodes,
                           duration_s=args.duration, topology=args.topology,
                           schedule_spec=args.schedule,
-                          statesync_ok=args.statesync)
+                          statesync_ok=args.statesync, durable=args.durable)
     print(json.dumps(asdict(report), indent=1, default=str))
     return 0 if report.ok else 1
 

@@ -8,6 +8,7 @@ import threading
 
 from tendermint_tpu.encoding import proto
 from tendermint_tpu.store import envelope
+from tendermint_tpu.utils import clock as _clock
 from tendermint_tpu.store.db import DB
 from tendermint_tpu.utils import faults
 from tendermint_tpu.types.evidence import (
@@ -29,11 +30,23 @@ def _committed_key(ev) -> bytes:
 
 
 class EvidencePool:
-    def __init__(self, db: DB, state_store, block_store, logger=None):
+    def __init__(self, db: DB, state_store, block_store, logger=None,
+                 clock=None):
         self._db = db
         self.state_store = state_store
         self.block_store = block_store
         self.logger = logger
+        # per-node time source (utils/clock.py): the one wall-clock read
+        # this pool makes (evidence_time fallback when no block meta exists)
+        # must follow the node's skewed clock, not the host's
+        self.clock = clock if clock is not None else _clock.DEFAULT
+        # expiry audit trail (docs/SOAK.md skew auditing): every pending row
+        # this pool ages out, with the block/time ages that justified it.
+        # The soak auditor asserts no entry was expired while still inside
+        # the block-count bound — the invariant clock skew must not break,
+        # because expiry requires BOTH ages past their limits and block
+        # counts cannot be skewed. Bounded ring; newest last.
+        self.expired_log: list[dict] = []
         self._mtx = threading.Lock()
         # votes reported by consensus, to be turned into evidence
         self._consensus_buffer: list[tuple[Vote, Vote]] = []
@@ -132,7 +145,8 @@ class EvidencePool:
                 else:
                     val_set = self.state_store.load_validators(vote_a.height)
                     block_meta = self.block_store.load_block_meta(vote_a.height)
-                    evidence_time = block_meta.header.time if block_meta else Time.now()
+                    evidence_time = (block_meta.header.time if block_meta
+                                     else Time.from_unix_ns(self.clock.now_ns()))
                 ev = DuplicateVoteEvidence.new(vote_a, vote_b, evidence_time, val_set)
                 if ev is not None:
                     with self._mtx:
@@ -144,6 +158,19 @@ class EvidencePool:
                         cb(ev)
             except Exception:  # noqa: BLE001 - can't form evidence; drop
                 pass
+
+    def _note_expiry(self, ev, age_blocks: int, age_ns: int, params) -> None:
+        """Record one expiry decision (prune or verify-reject) for the soak
+        auditor's false-expiry check. List append is GIL-atomic; the ring
+        bound keeps hour-scale soaks from growing it unboundedly."""
+        self.expired_log.append({
+            "height": ev.height(),
+            "age_blocks": age_blocks,
+            "age_ns": age_ns,
+            "max_age_num_blocks": params.max_age_num_blocks,
+            "max_age_duration_ns": params.max_age_duration_ns,
+        })
+        del self.expired_log[:-64]
 
     # --- verification (reference: evidence/verify.go) ----------------------
 
@@ -159,6 +186,7 @@ class EvidencePool:
         age_ns = state.last_block_time.unix_ns() - ev_time.unix_ns()
         if (age_blocks > ev_params.max_age_num_blocks
                 and age_ns > ev_params.max_age_duration_ns):
+            self._note_expiry(ev, age_blocks, age_ns, ev_params)
             raise EvidenceError(
                 f"evidence from height {ev.height()} is too old; min height is "
                 f"{height - ev_params.max_age_num_blocks}", reason="expired"
@@ -308,6 +336,7 @@ class EvidencePool:
                 age_ns = state.last_block_time.unix_ns() - ev.time().unix_ns()
                 if age_blocks > params.max_age_num_blocks and age_ns > params.max_age_duration_ns:
                     self._db.delete(k)
+                    self._note_expiry(ev, age_blocks, age_ns, params)
             if evidence_list:
                 self.version += 1
         # Convert buffered conflicting votes into DuplicateVoteEvidence now

@@ -152,10 +152,20 @@ class Node:
         self.mempool.pre_check = tx_pre_check(state)
         self.mempool.post_check = tx_post_check(state)
 
+        # per-node time source (utils/clock.py, docs/NEMESIS.md): every
+        # consensus/evidence wall-clock read goes through this object, so a
+        # fabric skew action (`node.clock.set_skew(...)`) desynchronizes ONE
+        # node of an in-process mesh. Born with the process default's skew
+        # so TMTPU_CLOCK_SKEW_S also skews a subprocess testnet node.
+        from tendermint_tpu.utils import clock as tmclock
+
+        self.clock = tmclock.Clock(skew_s=tmclock.DEFAULT.skew_s)
+
         # evidence pool
         from tendermint_tpu.evidence.pool import EvidencePool
 
-        self.evidence_pool = EvidencePool(new_db("memdb"), self.state_store, self.block_store)
+        self.evidence_pool = EvidencePool(new_db("memdb"), self.state_store,
+                                          self.block_store, clock=self.clock)
         self.store_repairer.evidence_db = self.evidence_pool._db
         self.evidence_pool.on_corruption = self.store_repairer.note
 
@@ -172,6 +182,7 @@ class Node:
             config.consensus, state, self.block_exec, self.block_store,
             mempool=self.mempool, evidence_pool=self.evidence_pool,
             priv_validator=self.priv_validator, event_bus=self.event_bus, wal=wal,
+            clock=self.clock,
         )
         if config.mempool.broadcast:
             self.mempool.enable_txs_available()
@@ -505,6 +516,46 @@ class Node:
         # release the ingest coalescer's executor thread (it holds strong
         # mempool/app refs; fabric churn would otherwise leak one parked
         # thread per stopped node, docs/INGEST.md)
+        self.mempool._ingest.stop()
+        self.proxy_app.stop()
+
+    def abort(self) -> None:
+        """Power-loss teardown (docs/SOAK.md crash actions): release this
+        incarnation's threads and sockets WITHOUT the orderly flushes
+        stop() performs — no consensus stop (whose WAL close is preceded by
+        completing the in-flight transition), no post-commit drain, no
+        indexer join, no sink/DB close — so the durable home is abandoned
+        exactly as the crash instant left it and a rebooted incarnation
+        must recover through handshake + WAL replay + fast-sync alone.
+
+        In-process honesty note: the hosting interpreter survives, so
+        bytes already buffered by the OS (and sqlite connections reaped by
+        GC) persist — a strict SUPERSET of what a real power cut keeps.
+        Sub-fsync damage (a torn WAL tail) is injected explicitly by the
+        crash harness on the abandoned home (faults.tear_wal_tail)."""
+        self._running = False
+        self.tracer.disable()
+        self.watchdog.stop()
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
+        if getattr(self, "grpc_server", None) is not None:
+            self.grpc_server.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+        # freeze consensus: pause() parks the receive routine and ticker
+        # but leaves the WAL unclosed and any half-finalized round state
+        # (e.g. a crash-site rule that aborted _finalize_commit) in place
+        self.consensus.pause()
+        if self.indexer_service is not None:
+            # detach from the event bus without draining queued postings —
+            # a crash loses exactly the not-yet-indexed tail
+            self.indexer_service.stop()
+        # park worker threads without flush_post_commit: queued event
+        # publishes for already-applied heights are lost, as in a crash
+        self.block_exec.stop()
+        self.switch.stop()
+        if getattr(self, "signer_endpoint", None) is not None:
+            self.signer_endpoint.close()
         self.mempool._ingest.stop()
         self.proxy_app.stop()
 

@@ -5,9 +5,16 @@ The sink writes normalized block/tx/event/attribute rows through any DB-API
 2.0 connection. The schema is the reference's (blocks, tx_results, events,
 attributes + the event_attributes/block_events/tx_events views); only the
 auto-increment spelling differs per dialect. This image ships no postgres
-driver, so the tested backend is the stdlib ``sqlite3`` (>=3.35 for
-RETURNING); a psycopg2 connection works unchanged — the dialect is picked
+driver, so the tested backend is the stdlib ``sqlite3`` (RETURNING when the
+library is >=3.35, a ``cursor.lastrowid``/``INSERT OR IGNORE`` fallback
+below that); a psycopg2 connection works unchanged — the dialect is picked
 from the driver module's ``paramstyle``.
+
+Write granularity: each ``index_block_events``/``index_tx`` call is its own
+transaction by default (the reference's per-call shape), but the post-commit
+indexer wraps a whole height in :meth:`SqlEventSink.height_txn` so the block
+header and every tx posting of that height commit as ONE sink transaction —
+one fsync per height instead of one per posting.
 
 Like the reference sink, this is write-only: reads (``get``/``search``/
 ``has``) are served by the kv indexer, and the backport adapters raise for
@@ -19,6 +26,7 @@ results is JSON throughout (state/txindex.py).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 import time
@@ -106,13 +114,99 @@ class SqlEventSink:
         self._conn = conn
         self.chain_id = chain_id
         self._mtx = threading.Lock()
+        self._deferred = 0  # height_txn nesting depth
         mod = type(conn).__module__.split(".")[0]
         self._pg = mod.startswith("psycopg")
         self._ph = "%s" if self._pg else "?"
+        if self._pg:
+            self._returning = True
+        else:
+            import sqlite3
+
+            self._returning = sqlite3.sqlite_version_info >= (3, 35)
         self.ensure_schema()
 
     def _sql(self, q: str) -> str:
         return q.replace("$", self._ph)
+
+    def _insert_row_id(self, cur, q: str, params):
+        """Run an ``INSERT ... RETURNING rowid`` and return the new rowid,
+        or None when an ON CONFLICT DO NOTHING clause swallowed a duplicate.
+
+        sqlite grew RETURNING in 3.35; on older libraries the same insert
+        is issued plain and the rowid read from ``cursor.lastrowid``, with
+        ON CONFLICT DO NOTHING respelled as INSERT OR IGNORE so the
+        duplicate case is still detectable (rowcount == 0)."""
+        if self._returning:
+            cur.execute(self._sql(q), params)
+            row = cur.fetchone()
+            return None if row is None else row[0]
+        q = q.replace(" RETURNING rowid", "")
+        if " ON CONFLICT DO NOTHING" in q:
+            q = q.replace(" ON CONFLICT DO NOTHING", "")
+            q = q.replace("INSERT INTO", "INSERT OR IGNORE INTO", 1)
+        cur.execute(self._sql(q), params)
+        if cur.rowcount == 0:
+            return None
+        return cur.lastrowid
+
+    # -- per-height transaction batching (ROADMAP item-5 follow-on) ----------
+
+    def _call_begin(self, cur) -> None:
+        if self._deferred:
+            cur.execute("SAVEPOINT height_call")
+
+    def _call_commit(self, cur) -> None:
+        if self._deferred:
+            cur.execute("RELEASE SAVEPOINT height_call")
+        else:
+            self._conn.commit()
+
+    def _call_rollback(self, cur) -> None:
+        if self._deferred:
+            # Unwind only this call's rows; earlier postings of the batched
+            # height stay staged.
+            cur.execute("ROLLBACK TO SAVEPOINT height_call")
+            cur.execute("RELEASE SAVEPOINT height_call")
+        else:
+            self._conn.rollback()
+
+    @contextlib.contextmanager
+    def height_txn(self):
+        """Batch every posting for one height into ONE sink transaction.
+
+        The post-commit indexer wraps a height's block-event and tx-event
+        postings in this context so the whole height commits atomically
+        (and with one fsync) instead of once per call. Inside the context
+        each index call runs under a savepoint instead of its own
+        transaction — a failing or duplicate call unwinds just its own
+        rows; exiting the context commits the height, an escaping
+        exception rolls the whole height back.
+
+        Reentrant: both backport adapters of one sink may be entered for
+        the same height (the indexer service does exactly that); the
+        commit/rollback happens at the outermost exit."""
+        with self._mtx:
+            self._deferred += 1
+            if self._deferred == 1 and not self._pg:
+                # sqlite: a savepoint opened in autocommit mode COMMITS at
+                # its RELEASE; pin an explicit transaction for the height
+                # so the per-call savepoints nest inside it. (psycopg opens
+                # one implicitly on the first statement.)
+                self._conn.cursor().execute("BEGIN")
+        try:
+            yield self
+        except Exception:
+            with self._mtx:
+                self._deferred -= 1
+                if self._deferred == 0:
+                    self._conn.rollback()
+            raise
+        else:
+            with self._mtx:
+                self._deferred -= 1
+                if self._deferred == 0:
+                    self._conn.commit()
 
     def ensure_schema(self) -> None:
         pk = ("BIGSERIAL PRIMARY KEY" if self._pg
@@ -138,10 +232,9 @@ class SqlEventSink:
             etype = e.type
             if not etype:
                 continue
-            cur.execute(self._sql(
+            eid = self._insert_row_id(cur,
                 "INSERT INTO events (block_id, tx_id, type) "
-                "VALUES ($, $, $) RETURNING rowid"), (block_id, tx_id, etype))
-            eid = cur.fetchone()[0]
+                "VALUES ($, $, $) RETURNING rowid", (block_id, tx_id, etype))
             for a in e.attributes or ():
                 if not a.index:
                     continue
@@ -157,10 +250,9 @@ class SqlEventSink:
         """psql.go:130 makeIndexedEvent: "type.name" becomes a single-
         attribute event."""
         etype, _, name = composite_key.partition(".")
-        cur.execute(self._sql(
+        eid = self._insert_row_id(cur,
             "INSERT INTO events (block_id, tx_id, type) "
-            "VALUES ($, $, $) RETURNING rowid"), (block_id, tx_id, etype))
-        eid = cur.fetchone()[0]
+            "VALUES ($, $, $) RETURNING rowid", (block_id, tx_id, etype))
         if name:
             cur.execute(self._sql(
                 "INSERT INTO attributes (event_id, key, composite_key, value) "
@@ -172,24 +264,23 @@ class SqlEventSink:
     def index_block_events(self, height: int, begin_events, end_events) -> None:
         with self._mtx:
             cur = self._conn.cursor()
+            self._call_begin(cur)
             try:
-                cur.execute(self._sql(
+                block_id = self._insert_row_id(cur,
                     "INSERT INTO blocks (height, chain_id, created_at) "
-                    "VALUES ($, $, $) ON CONFLICT DO NOTHING RETURNING rowid"),
+                    "VALUES ($, $, $) ON CONFLICT DO NOTHING RETURNING rowid",
                     (height, self.chain_id, self._now()))
-                row = cur.fetchone()
-                if row is None:  # duplicate: quietly succeed (psql.go:154)
-                    self._conn.rollback()
+                if block_id is None:  # duplicate: quiet success (psql.go:154)
+                    self._call_rollback(cur)
                     return
-                block_id = row[0]
                 self._meta_event(cur, block_id, None, BLOCK_HEIGHT_KEY,
                                  str(height))
                 # Order matters: begin-block before end-block (psql.go:166).
                 self._insert_events(cur, block_id, None, begin_events)
                 self._insert_events(cur, block_id, None, end_events)
-                self._conn.commit()
+                self._call_commit(cur)
             except Exception:
-                self._conn.rollback()
+                self._call_rollback(cur)
                 raise
 
     def index_tx(self, height: int, idx: int, tx: bytes, result) -> None:
@@ -199,6 +290,7 @@ class SqlEventSink:
         doc = _tx_result_doc(height, idx, tx, result, h)
         with self._mtx:
             cur = self._conn.cursor()
+            self._call_begin(cur)
             try:
                 cur.execute(self._sql(
                     "SELECT rowid FROM blocks WHERE height = $ AND "
@@ -209,25 +301,23 @@ class SqlEventSink:
                         f"no indexed block at height {height}; the block "
                         "header must be indexed before its transactions")
                 block_id = row[0]
-                cur.execute(self._sql(
+                tx_id = self._insert_row_id(cur,
                     "INSERT INTO tx_results (block_id, tx_index, created_at, "
                     "tx_hash, tx_result) VALUES ($, $, $, $, $) "
-                    "ON CONFLICT DO NOTHING RETURNING rowid"),
+                    "ON CONFLICT DO NOTHING RETURNING rowid",
                     (block_id, idx, self._now(), h,
                      json.dumps(doc).encode()))
-                row = cur.fetchone()
-                if row is None:  # duplicate: quietly succeed (psql.go:207)
-                    self._conn.rollback()
+                if tx_id is None:  # duplicate: quiet success (psql.go:207)
+                    self._call_rollback(cur)
                     return
-                tx_id = row[0]
                 self._meta_event(cur, block_id, tx_id, TX_HASH_KEY, h)
                 self._meta_event(cur, block_id, tx_id, TX_HEIGHT_KEY,
                                  str(height))
                 self._insert_events(cur, block_id, tx_id,
                                     result.events if result else ())
-                self._conn.commit()
+                self._call_commit(cur)
             except Exception:
-                self._conn.rollback()
+                self._call_rollback(cur)
                 raise
 
     def stop(self) -> None:
@@ -281,6 +371,9 @@ class BackportTxIndexer:
     def index(self, height: int, idx: int, tx: bytes, result) -> None:
         self._sink.index_tx(height, idx, tx, result)
 
+    def height_txn(self):
+        return self._sink.height_txn()
+
     def get(self, h: bytes):
         raise ValueError("the TxIndexer.Get method is not supported by the "
                          "sql event sink")
@@ -299,6 +392,9 @@ class BackportBlockIndexer:
     def index(self, height: int, begin_block_events, end_block_events) -> None:
         self._sink.index_block_events(height, begin_block_events,
                                       end_block_events)
+
+    def height_txn(self):
+        return self._sink.height_txn()
 
     def has(self, height: int) -> bool:
         raise ValueError("the BlockIndexer.Has method is not supported by "

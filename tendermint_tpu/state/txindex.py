@@ -18,6 +18,7 @@ any DB-API driver; tested on sqlite3).
 from __future__ import annotations
 
 import base64
+import contextlib
 import json
 import threading
 
@@ -76,7 +77,30 @@ class TxIndexer:
     def __init__(self, db: DB):
         self._db = db
         self._mtx = threading.Lock()
+        self._staged: list | None = None
         self.on_corruption = None
+
+    @contextlib.contextmanager
+    def height_txn(self):
+        """Batch one height's tx postings into a single write_batch (the kv
+        analogue of SqlEventSink.height_txn): index() calls stage their rows
+        while the context is open and the whole height lands in one batch on
+        exit — one store write per height instead of one per tx."""
+        with self._mtx:
+            if self._staged is not None:
+                raise RuntimeError("height_txn does not nest")
+            self._staged = []
+        try:
+            yield self
+        except Exception:
+            with self._mtx:
+                self._staged = None
+            raise
+        else:
+            with self._mtx:
+                sets, self._staged = self._staged, None
+                if sets:
+                    self._db.write_batch(sets)
 
     def index(self, height: int, idx: int, tx: bytes, result) -> None:
         h = tx_hash(tx)
@@ -115,7 +139,10 @@ class TxIndexer:
             pk = f"txe/{_esc(key)}/{_esc(value)}/{height}/{idx}".encode()
             sets.append((pk, envelope.wrap(h)))
         with self._mtx:
-            self._db.write_batch(sets)
+            if self._staged is not None:
+                self._staged.extend(sets)
+            else:
+                self._db.write_batch(sets)
 
     def get(self, h: bytes) -> dict | None:
         key = b"txr/" + h
@@ -314,23 +341,35 @@ class IndexerService:
             if bmsg is None:
                 continue
             d = bmsg.data
-            try:
-                self.block_indexer.index(
-                    d.header.height,
-                    d.result_begin_block.events if d.result_begin_block else [],
-                    d.result_end_block.events if d.result_end_block else [])
-            except Exception as e:  # noqa: BLE001
-                if self.logger:
-                    self.logger.error("failed to index block", err=e)
-            for _ in range(d.num_txs):
-                msg = None
-                while self._running and msg is None:
-                    msg = self._tx_sub.next(timeout=0.1)
-                if msg is None:
-                    return
-                t = msg.data
+            # Batch the height: every posting of this block (header + its
+            # num_txs tx results) lands in ONE indexer transaction when the
+            # backend offers a height_txn seam (kv batches the store write,
+            # the SQL sink commits once instead of 1 + num_txs times).
+            with contextlib.ExitStack() as stack:
+                for indexer in (self.block_indexer, self.tx_indexer):
+                    hx = getattr(indexer, "height_txn", None)
+                    if hx is not None:
+                        stack.enter_context(hx())
                 try:
-                    self.tx_indexer.index(t.height, t.index, t.tx, t.result)
+                    self.block_indexer.index(
+                        d.header.height,
+                        d.result_begin_block.events
+                        if d.result_begin_block else [],
+                        d.result_end_block.events
+                        if d.result_end_block else [])
                 except Exception as e:  # noqa: BLE001
                     if self.logger:
-                        self.logger.error("failed to index tx", err=e)
+                        self.logger.error("failed to index block", err=e)
+                for _ in range(d.num_txs):
+                    msg = None
+                    while self._running and msg is None:
+                        msg = self._tx_sub.next(timeout=0.1)
+                    if msg is None:
+                        return
+                    t = msg.data
+                    try:
+                        self.tx_indexer.index(t.height, t.index, t.tx,
+                                              t.result)
+                    except Exception as e:  # noqa: BLE001
+                        if self.logger:
+                            self.logger.error("failed to index tx", err=e)

@@ -16,6 +16,7 @@ from dataclasses import dataclass
 from functools import total_ordering
 
 from tendermint_tpu.encoding import proto
+from tendermint_tpu.utils import clock as _clock
 
 GO_ZERO_SECONDS = -62135596800  # 0001-01-01T00:00:00Z
 
@@ -32,8 +33,10 @@ class Time:
 
     @staticmethod
     def now() -> "Time":
-        ns = _time.time_ns()
-        return Time(ns // 1_000_000_000, ns % 1_000_000_000)
+        # reads through utils/clock so a skewed process (TMTPU_CLOCK_SKEW_S
+        # or a nemesis skew action on clock.DEFAULT) timestamps accordingly;
+        # per-node components read their own node Clock instead
+        return Time.from_unix_ns(_clock.now_ns())
 
     @staticmethod
     def from_unix_ns(ns: int) -> "Time":

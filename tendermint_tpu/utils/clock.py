@@ -1,0 +1,74 @@
+"""Per-node patchable time source (docs/NEMESIS.md clock-skew cookbook).
+
+Every wall-clock read that feeds consensus — proposal/vote/commit
+timestamps (`types/ttime.Time.now()`), round-0 scheduling, the timeout
+ticker, and evidence-expiry bookkeeping — goes through a `Clock` so a
+chaos harness can skew ONE node's notion of time without touching the
+host. Two knobs per clock:
+
+- ``skew_s``: a constant offset added to `time.time_ns()` (the classic
+  bad-NTP node). Drives the soak `skew:<node>:<±secs>` action.
+- ``rate``: a timer-rate multiplier consumed by the consensus ticker —
+  a node at rate 2.0 fires its round timeouts twice as fast (its
+  crystal runs hot), rate 0.5 half as fast.
+
+Module-level `DEFAULT` is the process clock; `TMTPU_CLOCK_SKEW_S` seeds
+its skew so a subprocess testnet node (e2e/runner.py) can be born skewed.
+In-process fabric nodes each hold their own `Clock` (node.Node.clock)
+threaded through ConsensusState, TimeoutTicker, and EvidencePool, so a
+50-node mesh can host mutually skewed clocks in one interpreter.
+
+This module imports nothing from the project (types/ttime.py sits below
+it in the layering).
+"""
+
+from __future__ import annotations
+
+import os
+import time as _time
+
+
+class Clock:
+    """A skewable, rate-adjustable wall clock."""
+
+    def __init__(self, skew_s: float = 0.0, rate: float = 1.0):
+        self._skew_ns = int(skew_s * 1e9)
+        self.rate = rate if rate > 0 else 1.0
+
+    def set_skew(self, skew_s: float) -> None:
+        # single int store: atomic under the GIL, no lock needed even
+        # with consensus threads reading concurrently
+        self._skew_ns = int(skew_s * 1e9)
+
+    @property
+    def skew_s(self) -> float:
+        return self._skew_ns / 1e9
+
+    def now_ns(self) -> int:
+        return _time.time_ns() + self._skew_ns
+
+    def now_s(self) -> float:
+        return self.now_ns() / 1e9
+
+    def timer_duration(self, duration_s: float) -> float:
+        """Host-clock seconds a relative timeout of `duration_s` takes on
+        this clock (a fast crystal — rate > 1 — fires timeouts early)."""
+        return duration_s / self.rate
+
+
+def _env_skew() -> float:
+    raw = os.environ.get("TMTPU_CLOCK_SKEW_S", "")
+    if not raw:
+        return 0.0
+    try:
+        return float(raw)
+    except ValueError:
+        return 0.0
+
+
+DEFAULT = Clock(skew_s=_env_skew())
+
+
+def now_ns() -> int:
+    """Process-default skewed wall clock (Time.now()'s source)."""
+    return DEFAULT.now_ns()

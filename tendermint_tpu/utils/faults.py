@@ -470,6 +470,51 @@ def corrupt_db(db, key: bytes, mode: str = "bitrot", seed: int = 0) -> bytes:
     return raw
 
 
+def tear_wal_tail(wal_dir: str, mode: str = "torn", seed: int = 0) -> int:
+    """Offline WAL-tail damage: cut the LAST frame of the newest chunk in
+    ``wal_dir`` — `corrupt_db`'s sibling for the consensus WAL. The live
+    ``wal.write`` torn/partial rules reproduce a power cut mid-append on a
+    running node; this one damages an ABANDONED home (the fabric's
+    hard-kill path tears the tail after the incarnation is gone, so a
+    reboot must drive `WAL._repair` exactly as a real torn crash would).
+
+    ``torn`` re-cuts the final frame inside its body (header intact, body
+    short); ``partial`` cuts inside the 8 header bytes. Returns the number
+    of bytes removed (0 when the log has no frame to tear)."""
+    if mode not in ("torn", "partial"):
+        raise FaultError(f"tear_wal_tail: unknown mode {mode!r} "
+                         "(want torn|partial)")
+    chunks = sorted(name for name in os.listdir(wal_dir)
+                    if name.startswith("wal.") and name[4:].isdigit())
+    if not chunks:
+        return 0
+    path = os.path.join(wal_dir, chunks[-1])
+    with open(path, "rb") as f:
+        data = f.read()
+    # find the last frame boundary with the WAL's own validity scan
+    from tendermint_tpu.consensus import wal as cwal
+
+    last_start = None
+    end = 0
+    for pos, fend, _t, _m in cwal._valid_frames(data):
+        last_start, end = pos, fend
+    if last_start is None or end < len(data):
+        return 0  # empty log, or the tail is already damaged
+    frame = data[last_start:end]
+    if len(frame) < 2:
+        return 0
+    rng = random.Random(f"{seed}:tear_wal_tail:{mode}:{chunks[-1]}")
+    if mode == "partial":
+        cut = rng.randint(1, min(7, len(frame) - 1))
+    else:
+        cut = rng.randint(min(8, len(frame) - 1), len(frame) - 1)
+    with open(path, "wb") as f:
+        f.write(data[:last_start] + frame[:cut])
+        f.flush()
+        os.fsync(f.fileno())
+    return len(frame) - cut
+
+
 def torn_write(site: str, fobj, frame: bytes) -> None:
     """Write sites (WAL append): on a torn/partial rule, write a
     deterministic prefix of ``frame``, push it to disk, and crash -- the

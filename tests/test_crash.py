@@ -1,0 +1,356 @@
+"""Crash-storm adversary plane (docs/SOAK.md crash cookbook): power-loss
+hard kills, WAL-tail tearing, reboot-from-home recovery, and per-node
+clock skew.
+
+Quick tier: the tear_wal_tail fault unit against real WAL repair, the
+hard-kill/reboot round trip on a durable 4-node fabric (torn tail + skew
+composed), ONE canonical finalize crash site, the clock plumbing units,
+and the skewed-clock evidence-pool no-false-expiry unit.
+
+Slow tier: the full matrix — a mid-transition freeze + hard kill at EVERY
+``consensus.finalize.*`` canonical crash site on a 5-node durable fabric,
+each rebooting and converging fork-free onto the fault-free app hash
+(exactly-once tx application: a double-applied block would fork the app
+hash, which full-prefix agreement then catches).
+"""
+
+import os
+import time
+
+import pytest
+
+from test_nemesis import _wait, repro  # noqa: F401 (shared harness)
+
+from tendermint_tpu.consensus import wal as cwal
+from tendermint_tpu.consensus.state_machine import ConsensusState
+from tendermint_tpu.e2e import fabric
+from tendermint_tpu.utils import clock as tmclock
+from tendermint_tpu.utils import faults, nemesis
+
+SEED = 2026
+
+FINALIZE_SITES = (
+    "consensus.finalize.save_block",
+    "consensus.finalize.end_height",
+    "consensus.finalize.apply_block",
+    "consensus.finalize.prune",
+    "consensus.finalize.done",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    faults.configure([], seed=SEED)
+    nemesis.clear()
+    yield
+    nemesis.clear()
+    nemesis.PLANE.on_heal.clear()
+    faults.clear()
+    faults.REGISTRY.crash_fn = lambda: os._exit(1)
+
+
+def _tweak(cfg, idx):
+    # hard-kill scenarios freeze consensus threads on purpose: keep the
+    # stall watchdog from "recovering" the corpse before the kill lands
+    cfg.consensus.watchdog_stall_s = lambda: 60.0
+
+
+# ---------------------------------------------------------------------------
+# Clock plumbing units (quick)
+# ---------------------------------------------------------------------------
+
+
+def test_clock_skew_and_rate():
+    c = tmclock.Clock()
+    base = c.now_ns()
+    c.set_skew(120.0)
+    assert c.skew_s == 120.0
+    assert c.now_ns() - base >= int(119.0 * 1e9)
+    c.set_skew(-60.0)
+    assert c.now_ns() - base <= int(-59.0 * 1e9)
+    assert tmclock.Clock(rate=4.0).timer_duration(2.0) == 0.5
+    # independent instances: skewing one never moves another
+    a, b = tmclock.Clock(), tmclock.Clock()
+    a.set_skew(500.0)
+    assert abs(b.now_ns() - tmclock.now_ns()) < int(5e9)
+
+
+def test_ticker_honors_clock_rate():
+    from tendermint_tpu.consensus.ticker import TimeoutInfo, TimeoutTicker
+
+    fired = []
+    t = TimeoutTicker(fired.append, clock=tmclock.Clock(rate=50.0))
+    t.schedule_timeout(TimeoutInfo(duration_s=2.0, height=1, round=0, step=1))
+    assert _wait(lambda: fired, 1.0), "rate-50 clock must fire a 2s timeout fast"
+    t.stop()
+
+
+# ---------------------------------------------------------------------------
+# tear_wal_tail against real WAL repair (quick)
+# ---------------------------------------------------------------------------
+
+
+def _write_wal(path: str, n: int = 6) -> list:
+    w = cwal.WAL(path)
+    for h in range(1, n + 1):
+        w.write_sync(cwal.EndHeightMessage(h), h * 1000)
+    w.close()
+    return [tm.msg for tm, _ in cwal.WAL(path).iter_messages()]
+
+
+@pytest.mark.parametrize("mode", ["torn", "partial"])
+def test_tear_wal_tail_then_repair(tmp_path, mode):
+    """tear_wal_tail models the crash the in-process abort can't produce
+    (bytes the OS never flushed): the last frame is cut mid-body (torn)
+    or mid-header (partial), and WAL repair-on-open must trim exactly
+    back to the valid prefix."""
+    path = str(tmp_path / "cs.wal")
+    msgs = _write_wal(path)
+    assert len(msgs) == 6
+    removed = faults.tear_wal_tail(path, mode=mode, seed=7)
+    assert removed > 0
+    got = [tm.msg for tm, _ in cwal.WAL(path).iter_messages()]
+    assert got == msgs[:-1], "repair must trim exactly the torn frame"
+    # deterministic: same seed cuts the same bytes
+    path2 = str(tmp_path / "cs2.wal")
+    _write_wal(path2)
+    assert faults.tear_wal_tail(path2, mode=mode, seed=7) == removed
+
+
+def test_tear_wal_tail_idempotent_on_damaged_tail(tmp_path):
+    path = str(tmp_path / "cs.wal")
+    _write_wal(path)
+    assert faults.tear_wal_tail(path, seed=3) > 0
+    # already-torn tail: a second tear is a no-op, not double damage
+    assert faults.tear_wal_tail(path, seed=3) == 0
+    with pytest.raises(faults.FaultError):
+        faults.tear_wal_tail(path, mode="confetti")
+
+
+# ---------------------------------------------------------------------------
+# Hard-kill / reboot round trip (quick)
+# ---------------------------------------------------------------------------
+
+
+def test_hard_kill_requires_durable_homes(tmp_path):
+    cluster = fabric.Cluster(str(tmp_path), 3, topology="full")
+    cluster.start()
+    try:
+        with pytest.raises(RuntimeError, match="durable"):
+            cluster.hard_kill(1)
+        with pytest.raises(KeyError):
+            cluster.reboot(1)  # never crashed
+    finally:
+        cluster.stop()
+
+
+def test_hard_kill_torn_tail_reboot_converges(tmp_path):
+    """The tentpole round trip: power-loss kill mid-traffic with a torn
+    WAL tail on the abandoned home, a skewed survivor, survivors keep
+    committing, reboot re-joins the SAME identity from the home, and the
+    cluster converges with full-prefix agreement, strictly monotone BFT
+    header time, and no false evidence expiry."""
+    cluster = fabric.Cluster(str(tmp_path), 4, topology="full",
+                             durable=True, tweak=_tweak)
+    cluster.start()
+    try:
+        with repro("hard-kill torn-tail reboot"):
+            assert _wait(lambda: cluster.min_height() >= 2, 60, 0.1), \
+                f"no initial progress: {cluster.heights()}"
+            cluster.set_skew(3, 120.0)  # one skewed survivor, composed in
+            cluster.hard_kill(2, tear="torn", seed=SEED)
+            assert 2 not in cluster.nodes
+            assert all(2 not in fn.links for fn in cluster.nodes.values())
+            tip = cluster.max_height()
+            assert _wait(lambda: cluster.min_height() >= tip + 2, 60, 0.1), \
+                f"survivors stalled after kill: {cluster.heights()}"
+            cluster.reboot(2)
+            assert 2 in cluster.nodes
+            target = cluster.max_height() + 2
+            assert _wait(lambda: cluster.min_height() >= target, 90, 0.1), \
+                f"rebooted node never caught up: {cluster.heights()}"
+            audited = cluster.audit_agreement()
+            assert audited >= target
+            # BFT time strictly monotone along the agreed prefix even
+            # with the +120s skewed survivor (weighted-median header time)
+            times = [cluster.block_time(2, h) for h in range(1, audited + 1)]
+            assert all(b > a for a, b in zip(times, times[1:]))
+            for fn in cluster.nodes.values():
+                for e in fn.node.evidence_pool.expired_log:
+                    assert e["age_blocks"] > e["max_age_num_blocks"]
+    finally:
+        cluster.stop()
+
+
+def test_hard_kill_is_not_graceful_stop(tmp_path):
+    """A hard kill must leave the durable home exactly as the crash left
+    it: the consensus WAL is NOT closed/flushed by the kill, so the home
+    may legitimately hold a shorter WAL than a graceful stop would leave
+    — and reboot() recovers from whatever is there."""
+    cluster = fabric.Cluster(str(tmp_path), 4, topology="full",
+                             durable=True, tweak=_tweak)
+    cluster.start()
+    try:
+        with repro("hard-kill abandons home"):
+            assert _wait(lambda: cluster.min_height() >= 2, 60, 0.1)
+            home = cluster.nodes[1].home
+            gen0 = cluster.nodes[1].generation
+            cluster.hard_kill(1)
+            # the durable home survives the kill, object gone from the map
+            assert os.path.isdir(os.path.join(home, "cs.wal"))
+            cluster.reboot(1)
+            assert cluster.nodes[1].generation > gen0  # new incarnation
+            assert cluster.nodes[1].home == home       # same durable home
+            target = cluster.max_height() + 1
+            assert _wait(lambda: cluster.min_height() >= target, 90, 0.1), \
+                f"reboot from abandoned home failed: {cluster.heights()}"
+            cluster.audit_agreement()
+    finally:
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# Canonical finalize crash sites (one quick, full matrix slow)
+# ---------------------------------------------------------------------------
+
+
+def _freeze_victim_crash_fn(victim: dict):
+    """A crash_fn that simulates power loss INSIDE _finalize_commit: walk
+    to the ConsensusState frame that hit the site, freeze its receive
+    routine mid-transition (the drainer exits at the next _running check,
+    leaving the height half-finalized), and record it for the harness to
+    hard-kill. Returning lets the registry raise FaultInjected, which the
+    consensus crash shields swallow — the freeze is what persists."""
+    import sys
+
+    def crash_fn():
+        f = sys._getframe(1)
+        while f is not None:
+            cs = f.f_locals.get("self")
+            if isinstance(cs, ConsensusState):
+                cs._running = False
+                victim["cs"] = cs
+                break
+            f = f.f_back
+        return True
+
+    return crash_fn
+
+
+def _run_crash_site(tmp_path, site: str, nodes: int = 4):
+    victim: dict = {}
+    faults.REGISTRY.crash_fn = _freeze_victim_crash_fn(victim)
+    faults.configure([f"{site}:crash@1"], seed=SEED)
+    cluster = fabric.Cluster(str(tmp_path), nodes, topology="full",
+                             durable=True, tweak=_tweak)
+    cluster.start()
+    try:
+        with repro(f"crash site {site}"):
+            assert _wait(lambda: "cs" in victim, 60, 0.05), \
+                f"site {site} never hit: {cluster.heights()}"
+            idx = next(i for i, fn in cluster.nodes.items()
+                       if fn.node.consensus is victim["cs"])
+            cluster.hard_kill(idx, seed=SEED)
+            tip = cluster.max_height()
+            assert _wait(lambda: cluster.min_height() >= tip + 2, 60, 0.1), \
+                f"survivors stalled after {site} crash: {cluster.heights()}"
+            cluster.reboot(idx)
+            target = cluster.max_height() + 2
+            assert _wait(lambda: cluster.min_height() >= target, 90, 0.1), (
+                f"reboot after {site} crash never converged: "
+                f"{cluster.heights()}")
+            # fork-free full prefix ON the fault-free app-hash chain:
+            # exactly-once application (a replayed/skipped block at the
+            # crash point would diverge the app hash and fork here)
+            assert cluster.audit_agreement() >= target
+            metas = [cluster.nodes[i].node.block_store.load_block_meta(target)
+                     for i in sorted(cluster.nodes)]
+            hashes = {m.header.app_hash for m in metas}
+            assert len(hashes) == 1, f"app hash diverged at {target}: {hashes}"
+    finally:
+        cluster.stop()
+
+
+def test_crash_site_finalize_save_block(tmp_path):
+    """Quick canary for the matrix: power loss at the first finalize
+    crash site (before the block persists) recovers exactly-once."""
+    _run_crash_site(tmp_path, "consensus.finalize.save_block")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", FINALIZE_SITES)
+def test_crash_site_matrix(tmp_path, site):
+    """Hard kill at EVERY canonical finalize crash site on a 5-node
+    durable fabric: each incarnation reboots from its abandoned home and
+    converges fork-free onto the fault-free app hash."""
+    _run_crash_site(tmp_path, site, nodes=5)
+
+
+# ---------------------------------------------------------------------------
+# Skewed-clock evidence pool: no false expiry (quick)
+# ---------------------------------------------------------------------------
+
+
+def test_skewed_clock_never_falsely_expires_evidence():
+    """Expiry demands BOTH bounds (height AND duration): evidence young
+    in blocks survives even when the duration bound reads as blown —
+    which is exactly what a skewed clock or skewed BFT time produces.
+    The dual-bound expiry logs into expired_log, and only dual-bound."""
+    from tendermint_tpu.evidence.pool import EvidencePool, _pending_key
+    from tendermint_tpu.state.state import State
+    from tendermint_tpu.store.db import MemDB
+    from tendermint_tpu.store import envelope
+    from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+    from tendermint_tpu.types.params import ConsensusParams, EvidenceParams
+    from tendermint_tpu.types.ttime import Time
+    from tendermint_tpu.types.vote import Vote, PRECOMMIT_TYPE
+
+    def ev_at(height, n=0):
+        return DuplicateVoteEvidence(
+            vote_a=Vote(height=height, round=0, type=PRECOMMIT_TYPE,
+                        validator_address=bytes([0x11 + n]) * 20,
+                        signature=b"\x22" * 64),
+            vote_b=Vote(height=height, round=0, type=PRECOMMIT_TYPE,
+                        validator_address=bytes([0x11 + n]) * 20,
+                        signature=b"\x33" * 64),
+            total_voting_power=30, validator_power=10,
+            timestamp=Time(1_700_000_000, 0))
+
+    params = ConsensusParams(evidence=EvidenceParams(
+        max_age_num_blocks=100, max_age_duration_ns=int(60e9)))
+    skewed = tmclock.Clock(skew_s=3600.0)  # +1h node clock
+    pool = EvidencePool(MemDB(), None, None, clock=skewed)
+    young = ev_at(150)   # 50 blocks old: inside the height bound
+    old = ev_at(1, n=1)  # 199 blocks AND hours past: truly expired
+    for e in (young, old):
+        pool._db.set(_pending_key(e), envelope.wrap(e.bytes()))
+    state = State(chain_id="t", last_block_height=200,
+                  last_block_time=Time(1_700_009_000, 0),
+                  consensus_params=params)
+    pool.update(state, [])
+    assert pool.is_pending(young), \
+        "evidence young in blocks must survive a blown duration bound"
+    assert not pool.is_pending(old)
+    assert len(pool.expired_log) == 1
+    e = pool.expired_log[0]
+    assert e["height"] == 1 and e["age_blocks"] > e["max_age_num_blocks"]
+
+
+def test_node_clock_is_per_node(tmp_path):
+    """Each fabric node owns an independent Clock: set_skew moves one
+    node's time source and nobody else's, and a rebooted incarnation
+    comes back unskewed (a real machine's RTC outlives the power cut,
+    but the injected skew rode the dead process)."""
+    cluster = fabric.Cluster(str(tmp_path), 3, topology="full",
+                             durable=True, tweak=_tweak)
+    cluster.start()
+    try:
+        cluster.set_skew(1, 300.0)
+        assert cluster.nodes[1].node.clock.skew_s == 300.0
+        assert cluster.nodes[0].node.clock.skew_s == 0.0
+        assert _wait(lambda: cluster.min_height() >= 1, 60, 0.1)
+        cluster.hard_kill(1)
+        cluster.reboot(1)
+        assert cluster.nodes[1].node.clock.skew_s == 0.0
+    finally:
+        cluster.stop()

@@ -244,3 +244,89 @@ def test_generated_soak_long(tmp_path):
     assert report.ok, f"violations: {report.violations}\n{report.repro}"
     assert report.actions_fired >= 3
     assert max(report.heights.values()) >= 5
+
+
+# ---------------------------------------------------------------------------
+# Crash-storm plane (quick): grammar, durable generation/repro, and the
+# clock-skew auditor invariants on stub data (tests/test_crash.py and
+# tests/test_campaign.py drive the real fabrics).
+# ---------------------------------------------------------------------------
+
+
+def test_action_grammar_roundtrip_crash_and_skew():
+    for entry in ("@36:crash~3:2", "@37:crash~3:4:torn", "@39:crash~-1:5",
+                  "@42:crashstorm~3:2", "@45:skew~5:3:120", "@48:skew:3:-45"):
+        a = soak.SoakAction.parse(entry)
+        assert a.describe() == entry, entry
+
+
+def test_generate_durable_weights_crash_kinds():
+    s = soak.SoakSchedule.generate(7, 300.0, 8, durable=True)
+    kinds = {a.kind for a in s.actions}
+    assert kinds & {"crash", "crashstorm"}, sorted(kinds)
+    # generated crashes always reboot: the never-reboot form (~-1) is for
+    # hand-written quorum-cut scenarios, not random schedules
+    assert all(a.dur_s > 0 for a in s.actions
+               if a.kind in ("crash", "crashstorm"))
+    # volatile clusters have nothing to reboot from -> no crash kinds
+    kinds = {a.kind for a in soak.SoakSchedule.generate(7, 300.0, 8).actions}
+    assert not kinds & {"crash", "crashstorm"}, sorted(kinds)
+
+
+def test_repro_line_durable_token():
+    line = soak.repro_line(7, 4, "full", 30.0, "@6:crash~-1:1", durable=True)
+    assert "TMTPU_SOAK_DURABLE=1" in line and "\n" not in line
+    assert "TMTPU_SOAK_DURABLE" not in soak.repro_line(
+        7, 4, "full", 30.0, "@3:join")
+
+
+class _TimedStubCluster(_StubCluster):
+    def __init__(self, n):
+        super().__init__(n)
+        self.times: dict[int, float] = {}
+
+    def block_time(self, i, h):
+        return self.times.get(h)
+
+
+def test_auditor_bft_time_strict_monotonicity():
+    c = _TimedStubCluster(2)
+    a = soak.ContinuousAuditor(c, liveness_budget_s=999)
+    for h, t in ((1, 10.0), (2, 11.0), (3, 12.0)):
+        c.times[h] = t
+        for i in range(2):
+            c.commit(i, h, bytes([h]) * 32)
+    a.sweep()
+    assert not a.violations
+    # height 4's header time goes BACKWARD: flagged exactly once
+    c.times[4] = 11.5
+    for i in range(2):
+        c.commit(i, 4, b"\x04" * 32)
+    a.sweep()
+    a.sweep()
+    assert [v.kind for v in a.violations] == ["bft-time"]
+    assert "height 4" in a.violations[0].detail
+
+
+def test_auditor_false_expiry_from_pool_log():
+    c = _StubCluster(2)
+    a = soak.ContinuousAuditor(c, liveness_budget_s=999)
+
+    class _Pool:
+        expired_log = []
+
+    for i in range(2):
+        c.commit(i, 1, b"\x01" * 32)
+    c.nodes[1].node.evidence_pool = _Pool()
+    _Pool.expired_log.append(
+        {"height": 90, "age_blocks": 110, "max_age_num_blocks": 100})
+    a.sweep()
+    assert not a.violations, "dual-bound expiry must pass"
+    # a time-only expiry (height bound NOT exceeded) is the skew bug
+    _Pool.expired_log.append(
+        {"height": 150, "age_blocks": 50, "max_age_num_blocks": 100})
+    a.sweep()
+    a.sweep()  # seen-count: no double report of scanned entries
+    assert [v.kind for v in a.violations] == ["false-expiry"]
+    assert "node 1" in a.violations[0].detail
+    _Pool.expired_log = []

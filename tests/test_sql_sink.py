@@ -200,6 +200,7 @@ class _FakePgCursor:
     def __init__(self, cur, log):
         self._cur = cur
         self._log = log
+        self._returned = None
 
     @staticmethod
     def _translate(q):
@@ -212,9 +213,22 @@ class _FakePgCursor:
 
     def execute(self, q, params=()):
         self._log.append(q)
-        return self._cur.execute(self._translate(q), params)
+        self._returned = None
+        t = self._translate(q)
+        if " RETURNING rowid" in t:
+            # A real postgres serves RETURNING natively; the sqlite backing
+            # this fake may predate 3.35, so emulate it from lastrowid
+            # (None when ON CONFLICT DO NOTHING swallowed a duplicate).
+            self._cur.execute(t.replace(" RETURNING rowid", ""), params)
+            if self._cur.rowcount != 0:
+                self._returned = (self._cur.lastrowid,)
+            return self._cur
+        return self._cur.execute(t, params)
 
     def fetchone(self):
+        if self._returned is not None:
+            row, self._returned = self._returned, None
+            return row
         return self._cur.fetchone()
 
     def fetchall(self):
@@ -234,6 +248,9 @@ class _FakePgConnection:
 
     def commit(self):
         self._db.commit()
+
+    def rollback(self):
+        self._db.rollback()
 
 
 # rebind the class into a psycopg-looking module namespace
@@ -268,3 +285,133 @@ def test_postgres_dialect_shapes():
     cur.execute("SELECT type FROM events ORDER BY rowid")
     types = [r[0] for r in cur.fetchall()]
     assert "begin" in types and "transfer" in types
+
+
+# ---------------------------------------------------------------------------
+# Per-height transaction batching (SqlEventSink.height_txn + the kv
+# TxIndexer analogue the IndexerService drives through the same seam).
+# ---------------------------------------------------------------------------
+
+
+class _CountingConnection:
+    """sqlite3 connection wrapper counting commit/rollback round-trips."""
+
+    def __init__(self):
+        self._db = sqlite3.connect(":memory:")
+        self.commits = 0
+        self.rollbacks = 0
+
+    def cursor(self):
+        return self._db.cursor()
+
+    def commit(self):
+        self.commits += 1
+        self._db.commit()
+
+    def rollback(self):
+        self.rollbacks += 1
+        self._db.rollback()
+
+
+def test_height_txn_commits_once_per_height():
+    conn = _CountingConnection()
+    s = SqlEventSink(conn, "batch-chain")
+    base = conn.commits  # schema setup
+    res = ResponseDeliverTx(code=0, events=[_ev("transfer", n="1")])
+    with s.height_txn():
+        s.index_block_events(3, [_ev("begin", p="b")], [])
+        s.index_tx(3, 0, b"t0", res)
+        s.index_tx(3, 1, b"t1", res)
+        assert conn.commits == base, "postings must not commit mid-height"
+    assert conn.commits == base + 1  # ONE commit for the whole height
+    cur = conn.cursor()
+    cur.execute("SELECT COUNT(*) FROM tx_results")
+    assert cur.fetchone()[0] == 2
+
+
+def test_height_txn_duplicate_keeps_earlier_postings():
+    """The quiet-duplicate early return must unwind only its own savepoint,
+    not the height's earlier staged rows."""
+    s = _sink()
+    s.index_block_events(4, [], [])  # pre-existing height
+    with s.height_txn():
+        s.index_block_events(5, [_ev("begin", p="b")], [])
+        s.index_block_events(4, [_ev("dup", a="1")], [])  # duplicate: no-op
+        s.index_tx(5, 0, b"tx5", ResponseDeliverTx(code=0, events=[]))
+    cur = s._conn.cursor()
+    cur.execute("SELECT COUNT(*) FROM blocks")
+    assert cur.fetchone()[0] == 2
+    cur.execute("SELECT COUNT(*) FROM tx_results")
+    assert cur.fetchone()[0] == 1
+    cur.execute("SELECT COUNT(*) FROM events WHERE type = 'dup'")
+    assert cur.fetchone()[0] == 0
+
+
+def test_height_txn_failed_call_unwinds_only_itself():
+    s = _sink()
+    with s.height_txn():
+        s.index_block_events(6, [_ev("begin", p="b")], [])
+        with pytest.raises(ValueError):
+            # no block row at height 99 -> the call fails and its
+            # savepoint rolls back; height 6's rows stay staged
+            s.index_tx(99, 0, b"orphan", None)
+        s.index_tx(6, 0, b"ok", ResponseDeliverTx(code=0, events=[]))
+    cur = s._conn.cursor()
+    cur.execute("SELECT COUNT(*) FROM blocks")
+    assert cur.fetchone()[0] == 1
+    cur.execute("SELECT COUNT(*) FROM tx_results")
+    assert cur.fetchone()[0] == 1
+
+
+def test_height_txn_escaping_exception_discards_height():
+    conn = _CountingConnection()
+    s = SqlEventSink(conn, "rb-chain")
+    with pytest.raises(RuntimeError):
+        with s.height_txn():
+            s.index_block_events(7, [], [])
+            raise RuntimeError("boom")
+    cur = conn.cursor()
+    cur.execute("SELECT COUNT(*) FROM blocks")
+    assert cur.fetchone()[0] == 0
+    assert conn.rollbacks >= 1
+
+
+def test_height_txn_is_reentrant_across_backport_adapters():
+    """IndexerService enters the seam via BOTH backport adapters of one
+    sink; the commit must happen exactly once, at the outermost exit."""
+    conn = _CountingConnection()
+    s = SqlEventSink(conn, "reent-chain")
+    base = conn.commits
+    with s.block_indexer().height_txn():
+        with s.tx_indexer().height_txn():
+            s.index_block_events(8, [], [])
+            assert conn.commits == base
+        assert conn.commits == base, "inner exit must not commit"
+    assert conn.commits == base + 1
+
+
+def test_kv_tx_indexer_height_txn_batches_store_writes():
+    from tendermint_tpu.state.txindex import TxIndexer
+    from tendermint_tpu.store.db import MemDB
+
+    db = MemDB()
+    calls = []
+    orig = db.write_batch
+
+    def counting(sets):
+        calls.append(len(list(sets)))
+        return orig(sets)
+
+    db.write_batch = counting
+    ti = TxIndexer(db)
+    res = ResponseDeliverTx(code=0, events=[_ev("transfer", n="1")])
+    with ti.height_txn():
+        ti.index(9, 0, b"a", res)
+        ti.index(9, 1, b"b", res)
+        assert calls == [], "staged postings must not hit the store yet"
+    assert len(calls) == 1, "one write_batch per height"
+    assert ti.get(tx_hash(b"a")) is not None
+    assert ti.get(tx_hash(b"b")) is not None
+    # outside the context, per-tx writes are unchanged
+    ti.index(10, 0, b"c", res)
+    assert len(calls) == 2
