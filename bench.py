@@ -825,6 +825,144 @@ def config_concurrent_verify(rr):
                 iters_per_path=iters_per_path, gen_s=round(gen_s, 1))
 
 
+def config_light_serve(rr):
+    """ISSUE 20 acceptance: gateway light-serving throughput. C concurrent
+    clients chase the tip of a signed header chain through ONE shared
+    LightGateway (verified-answer cache + single-flight coalescing: ~H
+    verifications total) vs the SAME workload where every client runs its
+    own light client and verifies everything itself (serial: C*H
+    verifications). Reports aggregate queries/s, p99 serve latency, the
+    coalesced-vs-serial speedup, and the verify-service on/off delta.
+    Sigcache is pinned OFF so the serial baseline actually re-verifies."""
+    import threading
+
+    from tendermint_tpu.crypto import sigcache, verify_service
+    from tendermint_tpu.light.client import Client, TrustOptions
+    from tendermint_tpu.light.gateway import LightGateway
+    from tendermint_tpu.light.provider import MockProvider
+    from tendermint_tpu.light.store import DBStore
+    from tendermint_tpu.store.db import MemDB
+    from tendermint_tpu.types.ttime import Time
+
+    n_headers = int(os.environ.get("BENCH_LIGHT_HEADERS", 32))
+    n_clients = int(os.environ.get("BENCH_LIGHT_CLIENTS", 8))
+    t0 = time.monotonic()
+    chain = _gen_light_chain(n_headers, 16)
+    gen_s = time.monotonic() - t0
+    lbs = {lb.height: lb for lb in chain}
+    now = Time(1_700_000_000 + 10 * (n_headers + 2), 0)
+    period_s = 14 * 86400.0
+    opts = TrustOptions(period_s=period_s, height=1, hash=chain[0].hash())
+
+    def crowd(worker):
+        """C threads running `worker(client_index, latencies)`; returns
+        (wall_s, all latencies)."""
+        lat: list[list[float]] = [[] for _ in range(n_clients)]
+        errors: list = []
+
+        def run(c):
+            try:
+                worker(c, lat[c])
+            except Exception as e:  # noqa: BLE001 - surfaced after join
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(c,))
+                   for c in range(n_clients)]
+        t = time.monotonic()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.monotonic() - t
+        if errors:
+            raise RuntimeError(f"light_serve worker failed: {errors}")
+        return wall, [x for per in lat for x in per]
+
+    def pass_gateway():
+        gw = LightGateway(BENCH_CHAIN, opts,
+                          [MockProvider(BENCH_CHAIN, lbs) for _ in range(3)],
+                          DBStore(MemDB(), BENCH_CHAIN),
+                          sleep=lambda s: None)
+
+        def worker(c, out):
+            for h in range(2, n_headers + 1):
+                t = time.monotonic()
+                lb, _verdict = gw.serve_light_block(h, now=now)
+                out.append(time.monotonic() - t)
+                assert lb.height == h
+
+        return crowd(worker)
+
+    def pass_serial():
+        def worker(c, out):
+            client = Client(BENCH_CHAIN, opts,
+                            MockProvider(BENCH_CHAIN, lbs), [],
+                            DBStore(MemDB(), BENCH_CHAIN))
+            for h in range(2, n_headers + 1):
+                t = time.monotonic()
+                lb = client.verify_light_block_at_height(h, now)
+                out.append(time.monotonic() - t)
+                assert lb.height == h
+
+        return crowd(worker)
+
+    n_queries = n_clients * (n_headers - 1)
+
+    def measure(mode_pass, service_on):
+        prev = os.environ.get("TMTPU_VERIFY_SERVICE")
+        os.environ["TMTPU_VERIFY_SERVICE"] = "1" if service_on else "0"
+        verify_service.reset()
+        try:
+            mode_pass()  # warm kernels/keysets for this routing
+            walls, lat = [], []
+            for _ in range(2):
+                w, ls = mode_pass()
+                walls.append(w)
+                lat = ls
+            svc = verify_service.get()
+            lat.sort()
+            return dict(
+                wall_s=min(walls),
+                queries_per_s=n_queries / min(walls),
+                p50_ms=round(lat[len(lat) // 2] * 1e3, 2),
+                p99_ms=round(lat[min(int(len(lat) * 0.99),
+                                     len(lat) - 1)] * 1e3, 2),
+                launches=svc.launches, requests=svc.requests,
+            )
+        finally:
+            if prev is None:
+                os.environ.pop("TMTPU_VERIFY_SERVICE", None)
+            else:
+                os.environ["TMTPU_VERIFY_SERVICE"] = prev
+            verify_service.reset()
+
+    prev_sc = os.environ.get("TM_TPU_SIGCACHE")
+    os.environ["TM_TPU_SIGCACHE"] = "0"
+    try:
+        gw_on = measure(pass_gateway, True)
+        gw_off = measure(pass_gateway, False)
+        serial = measure(pass_serial, True)
+    finally:
+        if prev_sc is None:
+            os.environ.pop("TM_TPU_SIGCACHE", None)
+        else:
+            os.environ["TM_TPU_SIGCACHE"] = prev_sc
+        sigcache.reset()
+    speedup = gw_on["queries_per_s"] / max(serial["queries_per_s"], 1e-9)
+    return dict(metric=f"light_serve_{n_clients}c_queries_per_s",
+                value=round(gw_on["queries_per_s"], 1), unit="queries/s",
+                vs_baseline=round(speedup, 2),
+                speedup_vs_serial=round(speedup, 2),
+                serial_queries_per_s=round(serial["queries_per_s"], 1),
+                p99_serve_ms=gw_on["p99_ms"],
+                p99_serve_ms_serial=serial["p99_ms"],
+                service_off_queries_per_s=round(gw_off["queries_per_s"], 1),
+                service_stats=dict(launches=gw_on["launches"],
+                                   requests=gw_on["requests"],
+                                   launches_serial=serial["launches"]),
+                clients=n_clients, headers=n_headers, gen_s=round(gen_s, 1))
+
+
 def config_mempool_ingest(rr):
     """ISSUE 12 acceptance: sustained front-door txs/s and p99 admission
     latency, micro-batched coalescer vs the TMTPU_INGEST=0 serial baseline,
@@ -1086,6 +1224,7 @@ def main() -> None:
         ("sr25519", config_sr25519, (rr,)),
         ("addvote", config_addvote, (rr,)),
         ("concurrent_verify", config_concurrent_verify, (rr,)),
+        ("light_serve", config_light_serve, (rr,)),
         ("mempool_ingest", config_mempool_ingest, (rr,)),
         ("chain_throughput", config_chain_throughput, (rr,)),
         ("sharded", config_sharded, (rr, items)),
@@ -1123,6 +1262,10 @@ def main() -> None:
                                   "phase_attribution_off",
                                   "service_stats",
                                   "speedup_vs_serial",
+                                  "serial_queries_per_s",
+                                  "p99_serve_ms",
+                                  "p99_serve_ms_serial",
+                                  "service_off_queries_per_s",
                                   "serial_txs_per_s",
                                   "serial_blocks_per_s",
                                   "txs_per_block",

@@ -1,12 +1,17 @@
 """Config TOML rendering + loading (reference: config/toml.go).
 
 Writing uses a template mirroring the reference's section layout; reading
-uses stdlib tomllib.
+uses stdlib tomllib when available (3.11+), else a minimal parser covering
+exactly the subset write_config_toml emits.
 """
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python 3.10: no tomllib, no tomli in image
+    tomllib = None
+
 from dataclasses import fields as dc_fields
 
 from tendermint_tpu.config.config import Config
@@ -51,9 +56,114 @@ def write_config_toml(cfg: Config, path: str) -> None:
         fh.write("\n".join(lines))
 
 
+def _parse_scalar(tok: str):
+    tok = tok.strip()
+    if tok.startswith('"'):
+        out, i = [], 1
+        while i < len(tok):
+            c = tok[i]
+            if c == "\\" and i + 1 < len(tok):
+                out.append(tok[i + 1])
+                i += 2
+                continue
+            if c == '"':
+                break
+            out.append(c)
+            i += 1
+        return "".join(out)
+    if tok == "true":
+        return True
+    if tok == "false":
+        return False
+    try:
+        return int(tok)
+    except ValueError:
+        return float(tok)
+
+
+def _split_array_items(body: str) -> list:
+    items, depth, in_str, esc, cur = [], 0, False, False, []
+    for c in body:
+        if in_str:
+            cur.append(c)
+            if esc:
+                esc = False
+            elif c == "\\":
+                esc = True
+            elif c == '"':
+                in_str = False
+            continue
+        if c == '"':
+            in_str = True
+            cur.append(c)
+        elif c == "[":
+            depth += 1
+            cur.append(c)
+        elif c == "]":
+            depth -= 1
+            cur.append(c)
+        elif c == "," and depth == 0:
+            items.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+    tail = "".join(cur).strip()
+    if tail:
+        items.append(tail)
+    return items
+
+
+def _parse_value(tok: str):
+    tok = tok.strip()
+    if tok.startswith("["):
+        body = tok[1:tok.rindex("]")]
+        return [_parse_value(item) for item in _split_array_items(body)]
+    return _parse_scalar(tok)
+
+
+def _strip_comment(line: str) -> str:
+    in_str = esc = False
+    for i, c in enumerate(line):
+        if in_str:
+            if esc:
+                esc = False
+            elif c == "\\":
+                esc = True
+            elif c == '"':
+                in_str = False
+        elif c == '"':
+            in_str = True
+        elif c == "#":
+            return line[:i]
+    return line
+
+
+def parse_toml_minimal(text: str) -> dict:
+    """Parse the TOML subset write_config_toml emits (flat key = value
+    lines under optional [section] headers; strings, bools, ints, floats,
+    one-line arrays, # comments)."""
+    doc: dict = {}
+    cur = doc
+    for raw in text.splitlines():
+        line = _strip_comment(raw).strip()
+        if not line:
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            name = line[1:-1].strip()
+            cur = doc.setdefault(name, {})
+            continue
+        key, _, val = line.partition("=")
+        cur[key.strip()] = _parse_value(val)
+    return doc
+
+
 def load_toml_into(cfg: Config, path: str) -> Config:
-    with open(path, "rb") as fh:
-        doc = tomllib.load(fh)
+    if tomllib is not None:
+        with open(path, "rb") as fh:
+            doc = tomllib.load(fh)
+    else:
+        with open(path, "r") as fh:
+            doc = parse_toml_minimal(fh.read())
     for section, attr in _SECTIONS:
         obj = getattr(cfg, attr)
         src = doc if section == "" else doc.get(section, {})

@@ -1,0 +1,225 @@
+"""Pure-Python X25519 + ChaCha20-Poly1305 fallback for SecretConnection.
+
+The container may lack the ``cryptography`` package; this module provides
+drop-in shims with the same API surface SecretConnection uses
+(X25519PrivateKey.generate/public_key/exchange, ChaCha20Poly1305
+encrypt/decrypt).  Implementations follow RFC 7748 (X25519) and RFC 8439
+(ChaCha20-Poly1305) exactly — tests/test_purecrypto.py pins the RFC test
+vectors — so peers using this fallback interoperate with peers using the
+C-backed package.  Python-speed only; the p2p frame path tolerates it.
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+import struct
+
+# --- X25519 (RFC 7748) ------------------------------------------------------
+
+_P = 2**255 - 19
+_A24 = 121665
+
+
+def _decode_scalar(k: bytes) -> int:
+    b = bytearray(k)
+    b[0] &= 248
+    b[31] &= 127
+    b[31] |= 64
+    return int.from_bytes(bytes(b), "little")
+
+
+def _decode_u(u: bytes) -> int:
+    b = bytearray(u)
+    b[31] &= 127
+    return int.from_bytes(bytes(b), "little")
+
+
+def x25519(k: bytes, u: bytes) -> bytes:
+    """Scalar multiplication on Curve25519 (Montgomery ladder)."""
+    if len(k) != 32 or len(u) != 32:
+        raise ValueError("x25519 inputs must be 32 bytes")
+    ki = _decode_scalar(k)
+    x1 = _decode_u(u)
+    x2, z2, x3, z3 = 1, 0, x1, 1
+    swap = 0
+    for t in reversed(range(255)):
+        k_t = (ki >> t) & 1
+        swap ^= k_t
+        if swap:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = k_t
+        a = (x2 + z2) % _P
+        aa = a * a % _P
+        b = (x2 - z2) % _P
+        bb = b * b % _P
+        e = (aa - bb) % _P
+        c = (x3 + z3) % _P
+        d = (x3 - z3) % _P
+        da = d * a % _P
+        cb = c * b % _P
+        x3 = (da + cb) % _P
+        x3 = x3 * x3 % _P
+        z3 = (da - cb) % _P
+        z3 = z3 * z3 * x1 % _P
+        x2 = aa * bb % _P
+        z2 = e * (aa + _A24 * e) % _P
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    out = x2 * pow(z2, _P - 2, _P) % _P
+    return out.to_bytes(32, "little")
+
+
+_X25519_BASE = (9).to_bytes(32, "little")
+
+
+class X25519PublicKey:
+    def __init__(self, raw: bytes):
+        if len(raw) != 32:
+            raise ValueError("X25519 public key must be 32 bytes")
+        self._raw = bytes(raw)
+
+    @classmethod
+    def from_public_bytes(cls, data: bytes) -> "X25519PublicKey":
+        return cls(data)
+
+    def public_bytes_raw(self) -> bytes:
+        return self._raw
+
+
+class X25519PrivateKey:
+    def __init__(self, raw: bytes):
+        if len(raw) != 32:
+            raise ValueError("X25519 private key must be 32 bytes")
+        self._raw = bytes(raw)
+
+    @classmethod
+    def generate(cls) -> "X25519PrivateKey":
+        return cls(os.urandom(32))
+
+    @classmethod
+    def from_private_bytes(cls, data: bytes) -> "X25519PrivateKey":
+        return cls(data)
+
+    def public_key(self) -> X25519PublicKey:
+        return X25519PublicKey(x25519(self._raw, _X25519_BASE))
+
+    def exchange(self, peer_public_key: X25519PublicKey) -> bytes:
+        shared = x25519(self._raw, peer_public_key.public_bytes_raw())
+        if shared == b"\x00" * 32:
+            raise ValueError("x25519 shared secret is all zeros")
+        return shared
+
+
+# --- ChaCha20 (RFC 8439 §2.3) -----------------------------------------------
+
+_MASK32 = 0xFFFFFFFF
+
+
+def _chacha20_block(key_words, counter: int, nonce_words) -> bytes:
+    st = [
+        0x61707865, 0x3320646E, 0x79622D32, 0x6B206574,
+        key_words[0], key_words[1], key_words[2], key_words[3],
+        key_words[4], key_words[5], key_words[6], key_words[7],
+        counter, nonce_words[0], nonce_words[1], nonce_words[2],
+    ]
+    x = list(st)
+    for _ in range(10):
+        for a, b, c, d in (
+            (0, 4, 8, 12), (1, 5, 9, 13), (2, 6, 10, 14), (3, 7, 11, 15),
+            (0, 5, 10, 15), (1, 6, 11, 12), (2, 7, 8, 13), (3, 4, 9, 14),
+        ):
+            xa, xb, xc, xd = x[a], x[b], x[c], x[d]
+            xa = (xa + xb) & _MASK32
+            xd ^= xa
+            xd = ((xd << 16) | (xd >> 16)) & _MASK32
+            xc = (xc + xd) & _MASK32
+            xb ^= xc
+            xb = ((xb << 12) | (xb >> 20)) & _MASK32
+            xa = (xa + xb) & _MASK32
+            xd ^= xa
+            xd = ((xd << 8) | (xd >> 24)) & _MASK32
+            xc = (xc + xd) & _MASK32
+            xb ^= xc
+            xb = ((xb << 7) | (xb >> 25)) & _MASK32
+            x[a], x[b], x[c], x[d] = xa, xb, xc, xd
+    return struct.pack("<16I", *((x[i] + st[i]) & _MASK32 for i in range(16)))
+
+
+def chacha20_xor(key: bytes, counter: int, nonce: bytes, data: bytes) -> bytes:
+    key_words = struct.unpack("<8I", key)
+    nonce_words = struct.unpack("<3I", nonce)
+    out = bytearray(len(data))
+    for i in range(0, len(data), 64):
+        block = _chacha20_block(key_words, counter + i // 64, nonce_words)
+        chunk = data[i : i + 64]
+        ks = int.from_bytes(block[: len(chunk)], "little")
+        pt = int.from_bytes(chunk, "little")
+        out[i : i + len(chunk)] = (ks ^ pt).to_bytes(len(chunk), "little")
+    return bytes(out)
+
+
+# --- Poly1305 (RFC 8439 §2.5) -----------------------------------------------
+
+_P1305 = 2**130 - 5
+_CLAMP = 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+
+
+def poly1305_mac(key: bytes, msg: bytes) -> bytes:
+    r = int.from_bytes(key[:16], "little") & _CLAMP
+    s = int.from_bytes(key[16:32], "little")
+    acc = 0
+    for i in range(0, len(msg), 16):
+        block = msg[i : i + 16]
+        n = int.from_bytes(block, "little") + (1 << (8 * len(block)))
+        acc = (acc + n) * r % _P1305
+    return ((acc + s) & (2**128 - 1)).to_bytes(16, "little")
+
+
+# --- AEAD construction (RFC 8439 §2.8) --------------------------------------
+
+
+class InvalidTag(Exception):
+    pass
+
+
+def _pad16(data: bytes) -> bytes:
+    rem = len(data) % 16
+    return data + (b"\x00" * (16 - rem) if rem else b"")
+
+
+class ChaCha20Poly1305:
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise ValueError("ChaCha20Poly1305 key must be 32 bytes")
+        self._key = bytes(key)
+
+    def _tag(self, nonce: bytes, ct: bytes, aad: bytes) -> bytes:
+        otk = chacha20_xor(self._key, 0, nonce, b"\x00" * 32)
+        mac_data = (
+            _pad16(aad)
+            + _pad16(ct)
+            + struct.pack("<Q", len(aad))
+            + struct.pack("<Q", len(ct))
+        )
+        return poly1305_mac(otk, mac_data)
+
+    def encrypt(self, nonce: bytes, data: bytes, associated_data) -> bytes:
+        if len(nonce) != 12:
+            raise ValueError("nonce must be 12 bytes")
+        aad = associated_data or b""
+        ct = chacha20_xor(self._key, 1, nonce, data)
+        return ct + self._tag(nonce, ct, aad)
+
+    def decrypt(self, nonce: bytes, data: bytes, associated_data) -> bytes:
+        if len(nonce) != 12:
+            raise ValueError("nonce must be 12 bytes")
+        if len(data) < 16:
+            raise InvalidTag("ciphertext too short")
+        aad = associated_data or b""
+        ct, tag = data[:-16], data[-16:]
+        if not hmac.compare_digest(self._tag(nonce, ct, aad), tag):
+            raise InvalidTag("poly1305 tag mismatch")
+        return chacha20_xor(self._key, 1, nonce, ct)

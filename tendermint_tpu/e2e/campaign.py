@@ -33,7 +33,8 @@ attribution plus the auto-minimized repro line::
      "coverage": {"partition": 2, "crash": 1, ...},
      "stats": {"heights_audited": ..., "txs_submitted": ...,
      "actions_fired": ..., "max_height": ...},
-     "violations": [{"phase": 0, "kind": "liveness", "detail": ...}],
+     "violations": [{"phase": 0, "kind": "liveness", "detail": ...,
+     "last_phase": {"1": "consensus.precommit(h4)", ...}}],
      "repro": "TMTPU_SOAK_REPRO: ...", "minimized_repro": "..."}
 
 **Repro minimization.** On the first violating phase the campaign stops
@@ -59,6 +60,7 @@ from __future__ import annotations
 import json
 import os
 import random
+import re
 import time
 
 from tendermint_tpu.e2e.fabric import Cluster
@@ -88,6 +90,18 @@ def _violation_kind(v: str) -> str:
     if v.startswith("["):
         return v[1:].split("@")[0].strip()
     return "unknown"
+
+
+def _last_phases(v: str) -> dict[str, str]:
+    """Pull the flight-recorder attribution out of a violation's
+    ``[lagging: node 1@h0 last_phase=consensus.precommit(h4), ...]``
+    suffix into ``{node: phase}`` — the artifact consumer (and the
+    minimizer's human reader) gets WHERE each lagging node was stuck as
+    structured data instead of re-parsing the detail string."""
+    out: dict[str, str] = {}
+    for m in re.finditer(r"node (\d+)@h\d+ last_phase=([^,\]]+)", str(v)):
+        out[m.group(1)] = m.group(2).strip()
+    return out
 
 
 def _gap_action(kind: str, at_s: float, target: int) -> SoakAction | None:
@@ -273,7 +287,8 @@ def run_campaign(root: str, seed: int = 1, budget_s: float = DEFAULT_BUDGET_S,
         })
         for v in rep.violations:
             violations.append({"phase": i, "kind": _violation_kind(v),
-                               "detail": str(v)})
+                               "detail": str(v),
+                               "last_phase": _last_phases(v)})
         if rep.violations:
             repro = rep.repro
             if minimize_on_violation:

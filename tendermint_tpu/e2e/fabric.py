@@ -745,6 +745,19 @@ class Cluster:
         """Back-compat shim for the soak ``evidence`` action."""
         self.install_byzantine(idx, name)
 
+    # --- light-client serving -----------------------------------------------
+
+    def light_provider(self, idx: int, name: str | None = None):
+        """A light-block Provider view of one fabric node, byzantine-aware:
+        it mirrors the RPC ``light_block`` route's seam exactly — a node
+        carrying ``byzantine_light_blocks`` (the lunatic_proposer staging
+        map, docs/BYZANTINE.md) serves its FAKES first, else it reads the
+        honest stores through NodeProvider (so corrupted rows surface as
+        clean not-found, never rotten bytes). The provider resolves the
+        node at call time, so restarts/reboots swap the backing object
+        transparently and a hard-killed index answers ErrNoResponse."""
+        return _FabricLightProvider(self, idx, name or f"node{idx}")
+
     # --- load ---------------------------------------------------------------
 
     def submit_tx(self, tx: bytes, via: int | None = None) -> bool:
@@ -809,6 +822,51 @@ class Cluster:
             f"fd budget exceeded: {r['fds']} fds over {r['fd_budget']} "
             f"for {r['nodes']} nodes / {r['links']} links")
         return r
+
+
+class _FabricLightProvider:
+    """Cluster.light_provider's duck-typed Provider (light/provider.py
+    contract): call-time node resolution + the byzantine-fakes-first seam
+    shared with the rpc/core.py ``light_block`` route."""
+
+    def __init__(self, cluster: "Cluster", idx: int, name: str):
+        self.cluster = cluster
+        self.idx = idx
+        self.name = name
+        self.evidences: list = []
+
+    def chain_id(self) -> str:
+        return self.cluster.chain_id
+
+    def _node(self):
+        fn = self.cluster.nodes.get(self.idx)
+        if fn is None:
+            from tendermint_tpu.light.provider import ErrNoResponse
+
+            raise ErrNoResponse(f"fabric node {self.idx} is down")
+        return fn.node
+
+    def light_block(self, height: int):
+        from tendermint_tpu.light.provider import NodeProvider
+
+        node = self._node()
+        fakes = getattr(node, "byzantine_light_blocks", None)
+        if fakes:
+            lb = fakes.get(height or node.block_store.height)
+            if lb is not None:
+                return lb
+        return NodeProvider(self.cluster.chain_id, node.block_store,
+                            node.state_store).light_block(height)
+
+    def report_evidence(self, ev) -> None:
+        self.evidences.append(ev)
+        # land it in the live pool too: the gateway's detector reports
+        # flow into consensus exactly like an RPC broadcast_evidence
+        try:
+            self._node().evidence_pool.add_evidence(ev)
+        except Exception:  # noqa: BLE001 - a down/byzantine sink is fine;
+            # the detector already reported to the other side
+            pass
 
 
 def _open_fds() -> int:

@@ -22,6 +22,8 @@ _FASTSYNC = ("v0", "v0", "v1", "v2")  # v0 weighted: the default path
 _PERTURB_ACTIONS = ("kill", "restart", "pause", "partition")
 # Clock-skew dimension, seconds (negative = the node lives in the past).
 _CLOCK_SKEWS = (-90, -30, 45, 120, 600)
+# Light-client crowd sizes (docs/LIGHT.md light-serving dimension).
+_LIGHT_CROWDS = (4, 8, 16)
 # Byzantine behavior dimension (docs/BYZANTINE.md): derived from the
 # authoritative consensus/misbehavior.py catalog (minus the `absent`
 # alias) so a behavior added there enters the nightly matrix
@@ -81,6 +83,10 @@ def generate_one(rng: random.Random, index: int = 0) -> Manifest:
     if n_vals >= 3 and rng.random() < 0.25:
         skewed = rng.randrange(n_vals)
         skew_s = float(rng.choice(_CLOCK_SKEWS))
+    # Light-serving dimension (docs/LIGHT.md): a quarter of manifests run
+    # a gateway light-client crowd over the finished net's real RPC —
+    # every verified answer is cross-checked against the committed chain.
+    light_clients = rng.choice(_LIGHT_CROWDS) if rng.random() < 0.25 else 0
     return Manifest(
         validators=n_vals,
         chain_id=f"gen-{index}",
@@ -94,6 +100,7 @@ def generate_one(rng: random.Random, index: int = 0) -> Manifest:
         statesync_joiner=n_vals >= 3 and rng.random() < 0.25,
         skewed_node=skewed,
         clock_skew_s=skew_s,
+        light_clients=light_clients,
     )
 
 

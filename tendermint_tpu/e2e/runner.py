@@ -150,6 +150,13 @@ class Manifest:
     # -1 = no skewed node.
     skewed_node: int = -1
     clock_skew_s: float = 0.0
+    # Light-serving dimension (docs/LIGHT.md): after the perturbation
+    # matrix settles, run this many concurrent light clients behind one
+    # LightGateway over the net's real RPC and cross-check every VERIFIED
+    # answer against the chain's committed block id. Refusals are fine
+    # (refuse-over-lie is the gateway contract); a hash mismatch fails
+    # the run. 0 = no light-serving stage.
+    light_clients: int = 0
 
     @staticmethod
     def from_file(path: str) -> "Manifest":
@@ -531,6 +538,94 @@ class Runner:
             idle_budget_s=within_s, hard_cap_s=within_s * 4.0,
             what=f"all nodes within {delta} heights of the tip")
 
+    def light_crowd_report(self, n_clients: int,
+                           queries_each: int = 6) -> dict:
+        """``n_clients`` concurrent light clients behind one LightGateway
+        over the net's real RPC (docs/LIGHT.md): node0 is the primary,
+        the other reachable nodes witnesses/spares, the trust anchor is
+        the earliest still-in-trust-period header. Each client hammers
+        seeded height queries; every VERIFIED answer is cross-checked
+        against the committed block id node0 reports. Refusals are
+        acceptable — a mismatch means the gateway served a wrong answer
+        and fails the run."""
+        import random
+        import threading
+
+        from tendermint_tpu.light.client import TrustOptions
+        from tendermint_tpu.light.gateway import LightGateway
+        from tendermint_tpu.light.provider import HTTPProvider
+        from tendermint_tpu.light.store import DBStore
+        from tendermint_tpu.light.verifier import header_expired
+        from tendermint_tpu.store.db import MemDB
+        from tendermint_tpu.types.ttime import Time
+
+        chain_id = self.m.chain_id or "e2e-chain"
+        alive = []
+        for i in sorted(self.rpc_addrs):
+            try:
+                self._rpc(i, "status", {})
+            except Exception:  # noqa: BLE001 - a down node can't serve
+                continue
+            alive.append(i)
+        assert alive, "no reachable RPC node to serve light clients"
+        alive = alive[:4]
+        providers = [HTTPProvider(chain_id, self.rpc_addrs[i])
+                     for i in alive]
+        period_s = 168 * 3600
+        anchor = providers[0].light_block(0)
+        now = Time.now()
+        for h in range(1, min(anchor.height, 17)):
+            lb = providers[0].light_block(h)
+            if not header_expired(lb.signed_header, period_s, now):
+                anchor = lb
+                break
+        gw = LightGateway(
+            chain_id,
+            TrustOptions(period_s=period_s, height=anchor.height,
+                         hash=anchor.hash()),
+            providers, DBStore(MemDB(), chain_id),
+            provider_names=[f"node{i}" for i in alive])
+        tip = max(self.max_height(), 1)
+        stats = {"clients": n_clients, "queries": 0, "served": 0,
+                 "refused": 0, "mismatches": []}
+        mtx = threading.Lock()
+
+        def client(c: int) -> None:
+            rng = random.Random(f"light:{self.m.chain_id}:{c}")
+            for _ in range(queries_each):
+                height = rng.randint(1, tip)
+                try:
+                    lb, _verdict = gw.serve_light_block(height)
+                except Exception:  # noqa: BLE001 - typed refusal, not a lie
+                    with mtx:
+                        stats["queries"] += 1
+                        stats["refused"] += 1
+                    continue
+                try:
+                    want = self._rpc(alive[0], "block",
+                                     {"height": str(lb.height)})
+                    want_hash = want["block_id"]["hash"].lower()
+                except Exception:  # noqa: BLE001 - chain check unavailable
+                    want_hash = None
+                with mtx:
+                    stats["queries"] += 1
+                    stats["served"] += 1
+                    if (want_hash is not None
+                            and lb.hash().hex().lower() != want_hash):
+                        stats["mismatches"].append(lb.height)
+
+        threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                   for c in range(n_clients)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60.0)
+        assert stats["served"] > 0, f"crowd never got an answer: {stats}"
+        assert not stats["mismatches"], (
+            f"gateway served wrong answers at heights {stats['mismatches']}")
+        stats["gateway"] = gw.describe()["counters"]
+        return stats
+
     def join_statesync_node(self, timeout_s: float = 120.0) -> int:
         """Spawn a NEW non-validator node that joins the live net via state
         sync (snapshot bootstrap + light-client trust through node0's RPC),
@@ -660,6 +755,8 @@ def run_manifest(manifest: Manifest, workdir: str,
         if with_load_report:
             report = r.load_report()
         report["heights_audited"] = audited
+        if manifest.light_clients:
+            report["light"] = r.light_crowd_report(manifest.light_clients)
         if manifest.statesync_joiner:
             report["joiner_index"] = r.join_statesync_node()
     finally:

@@ -50,6 +50,8 @@ seeded link decisions).
     @42:crashstorm~3:2           hard-kill 2 seeded nodes at once, reboot all
     @45:skew~5:3:120             skew node 3's clock +120 s for 5 s
     @48:skew:3:-45               skew node 3 by -45 s for the rest of the run
+    @50:lightcrowd~8:16          16 gateway light clients for 8 s (no dur:
+                                 the crowd rides to the end of the soak)
 
 The ``crash``/``crashstorm`` actions need a DURABLE cluster
 (``Cluster(durable=True)``; ``run_soak(durable=True)`` /
@@ -86,6 +88,14 @@ misbehavior that converges on some nodes but not others, or lands twice,
 is a violation — flight-recorder-annotated like a liveness stall), and the
 block-hash agreement audit covers the HONEST prefix only.
 
+The ``lightcrowd`` action attaches a crowd of concurrent light clients to
+a :class:`~tendermint_tpu.light.gateway.LightGateway` built over the live
+fabric and rides it through whatever else the schedule composes. Its audit
+face is the WRONG-ANSWER invariant: every verified answer any client
+receives must match the block hash the honest cluster agreed at that
+height, and every client must receive the same answer — a gateway may
+refuse (typed degradation) but must never lie (docs/LIGHT.md).
+
 The driver tracks quorum arithmetic: while an installed partition leaves no
 side with >2/3 of the voting power, the auditor is told a stall is EXPECTED
 (that freeze is the safety property, not a liveness bug); heal restores the
@@ -110,7 +120,7 @@ DEFAULT_TOPOLOGY = "k-regular:4"
 
 _KINDS = ("partition", "linkfault", "flood", "join", "join_statesync",
           "power", "restart", "leave", "evidence", "bitrot", "byz",
-          "crash", "crashstorm", "skew")
+          "crash", "crashstorm", "skew", "lightcrowd")
 
 # actions that only make sense against a durable cluster: a hard kill
 # abandons the live object and reboots from the on-disk home
@@ -197,7 +207,8 @@ class SoakSchedule:
         step = duration_s * 0.7 / slots
         t = duration_s * 0.15
         kinds = ["partition", "linkfault", "join", "power", "flood",
-                 "restart", "evidence", "bitrot", "byz", "skew"]
+                 "restart", "evidence", "bitrot", "byz", "skew",
+                 "lightcrowd"]
         if statesync_ok:
             kinds.append("join_statesync")
         if durable:
@@ -272,6 +283,13 @@ class SoakSchedule:
                 secs = rng.choice((-90, -30, 45, 120, 600))
                 actions.append(SoakAction(round(t, 1), kind,
                                           f"{target}:{secs}", dur))
+            elif kind == "lightcrowd":
+                # a crowd of gateway light clients riding whatever else
+                # the schedule composes: every verified answer is checked
+                # against the agreed honest prefix (docs/LIGHT.md)
+                actions.append(SoakAction(round(t, 1), kind,
+                                          str(rng.choice((4, 8, 16))),
+                                          round(dur + 2.0, 1)))
             elif kind == "bitrot":
                 # at-rest corruption of one node's storage plane: the
                 # scrubber must detect it and the repairer heal it with
@@ -290,7 +308,7 @@ class SoakSchedule:
 @dataclass
 class Violation:
     kind: str      # "fork" | "liveness" | "audit" | "evidence"
-                   # | "bft-time" | "false-expiry"
+                   # | "bft-time" | "false-expiry" | "wrong-answer"
     detail: str
     at_s: float = 0.0
 
@@ -363,6 +381,13 @@ class ContinuousAuditor:
         self._ev_scanned: dict[int, tuple] = {}  # idx -> (gen key, height)
         self._ev_flagged: set = set()            # (hash, idx) pairs reported
         self._ev_converged: set = set()
+        # wrong-answer books (the lightcrowd invariant): height -> the
+        # first verified answer any gateway client got (hash, who); a
+        # height reports at most once
+        self._light_answers: dict[int, tuple[bytes, str]] = {}
+        self._light_flagged: set[int] = set()
+        self._light_mtx = threading.Lock()
+        self.light_answers_audited = 0
         self._t0 = 0.0
         self._last_advance = 0.0
         self._best = 0
@@ -435,6 +460,53 @@ class ContinuousAuditor:
             pass
         return "; ".join(parts)
 
+    # --- the wrong-answer invariant (lightcrowd action, docs/LIGHT.md) ------
+
+    def note_light_answer(self, height: int, block_hash: bytes,
+                          who: str) -> None:
+        """Called by gateway light clients for every VERIFIED answer they
+        receive. Two invariants: (a) all clients get the SAME verified
+        answer per height (checked immediately — the first answer pins
+        it), and (b) that answer matches the hash the honest cluster
+        agreed at that height (checked against ``_agreed`` as heights get
+        pinned, in :meth:`_sweep_light_answers`). A violation here means
+        a gateway handed out a header that passed light-client
+        verification but diverges from the honest chain — the exact
+        failure the witness/detector plane exists to make impossible."""
+        with self._light_mtx:
+            prev = self._light_answers.get(height)
+            if prev is None:
+                self._light_answers[height] = (block_hash, who)
+                self.light_answers_audited += 1
+                return
+            if prev[0] == block_hash or height in self._light_flagged:
+                return
+            self._light_flagged.add(height)
+        self._record("wrong-answer",
+                     f"two verified answers at height {height}: "
+                     f"{prev[0].hex()[:16]} ({prev[1]}) vs "
+                     f"{block_hash.hex()[:16]} ({who})")
+
+    def _sweep_light_answers(self) -> None:
+        with self._light_mtx:
+            pending = [(h, bh, who)
+                       for h, (bh, who) in self._light_answers.items()
+                       if h not in self._light_flagged]
+        for h, bh, who in pending:
+            agreed = self._agreed.get(h)
+            if agreed is None or agreed == bh:
+                continue
+            with self._light_mtx:
+                if h in self._light_flagged:
+                    continue
+                self._light_flagged.add(h)
+            lag = self._lag_annotation()
+            self._record("wrong-answer",
+                         f"gateway served {bh.hex()[:16]} at height {h} "
+                         f"({who}) but the honest cluster agreed "
+                         f"{agreed.hex()[:16]}"
+                         + (f" [lagging: {lag}]" if lag else ""))
+
     def sweep(self) -> None:
         """One audit pass (public so tests and the final drain call it
         synchronously)."""
@@ -486,6 +558,7 @@ class ContinuousAuditor:
             best = max(best, tip)
         self._sweep_evidence(byz)
         self._sweep_expiry(byz)
+        self._sweep_light_answers()
         now = time.monotonic()
         if best > self._best:
             self._best = best
@@ -639,6 +712,125 @@ class ContinuousAuditor:
                     + (f" [lagging: {lag}]" if lag else ""))
 
 
+# --- the light-client crowd (lightcrowd action) ------------------------------
+
+
+class LightCrowd:
+    """A crowd of concurrent light clients riding one LightGateway built
+    over the live fabric (docs/LIGHT.md): the serving-plane face of the
+    soak. Each client thread hammers seeded height queries (plus the odd
+    latest-head refresh) while the schedule composes partitions, churn,
+    bitrot and byzantine behavior underneath; every VERIFIED answer is
+    reported to the auditor's wrong-answer invariant. The provider pool
+    deliberately includes byzantine nodes — they are the liars the
+    gateway's scoreboard must demote and evict mid-crowd — but the
+    PRIMARY and the trust anchor come from an honest node (a light client
+    bootstraps from a trusted anchor by definition; docs/LIGHT.md)."""
+
+    def __init__(self, cluster: Cluster, auditor: ContinuousAuditor,
+                 n_clients: int = 8, seed: int = 0, logger=None):
+        self.cluster = cluster
+        self.auditor = auditor
+        self.n_clients = n_clients
+        self.seed = seed
+        self.logger = logger
+        self.gateway = None
+        self.queries = 0
+        self.served = 0
+        self.refused = 0
+        self.verdicts: dict[str, int] = {}
+        self._mtx = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> None:
+        from tendermint_tpu.light.client import TrustOptions
+        from tendermint_tpu.light.gateway import LightGateway
+        from tendermint_tpu.light.store import DBStore
+        from tendermint_tpu.store.db import MemDB
+
+        byz = getattr(self.cluster, "byzantine", set())
+        honest = [i for i in sorted(self.cluster.nodes) if i not in byz]
+        if not honest:
+            raise RuntimeError("lightcrowd needs an honest node to anchor on")
+        pool = honest[:1] + [i for i in sorted(self.cluster.nodes)
+                             if i != honest[0]]
+        providers = [self.cluster.light_provider(i) for i in pool[:6]]
+        # bootstrap like a real light client: anchor on the EARLIEST
+        # still-in-trust-period header and verify forward — the posture
+        # that actually exercises skipping verification (and that a
+        # posterior-corruption lunatic attacks); block 1 carries the
+        # genesis timestamp, which may predate the trust period
+        from tendermint_tpu.light.verifier import header_expired
+        from tendermint_tpu.types.ttime import Time
+
+        period_s = 168 * 3600
+        anchor = providers[0].light_block(0)
+        node0 = self.cluster.nodes[honest[0]].node
+        base = max(node0.block_store.base, 1)
+        now = Time.now()
+        for h in range(base, min(anchor.height, base + 16)):
+            lb = providers[0].light_block(h)
+            if not header_expired(lb.signed_header, period_s, now):
+                anchor = lb
+                break
+        opts = TrustOptions(period_s=period_s, height=anchor.height,
+                            hash=anchor.hash())
+        self.gateway = LightGateway(
+            self.cluster.chain_id, opts, providers,
+            DBStore(MemDB(), self.cluster.chain_id),
+            provider_names=[p.name for p in providers],
+            node=self.cluster.nodes[honest[0]].node,
+            seed=self.seed, logger=self.logger)
+        for c in range(self.n_clients):
+            th = threading.Thread(target=self._client, args=(c,),
+                                  name=f"lightcrowd-{c}", daemon=True)
+            self._threads.append(th)
+            th.start()
+
+    def _client(self, c: int) -> None:
+        rng = random.Random(f"lightcrowd:{self.seed}:{c}")
+        while not self._stop.is_set():
+            tip = max(self.cluster.max_height(), 1)
+            height = 0 if rng.random() < 0.1 else rng.randint(1, tip)
+            try:
+                if height == 0:
+                    lb, verdict = self.gateway.serve_latest()
+                else:
+                    lb, verdict = self.gateway.serve_light_block(height)
+            except Exception:  # noqa: BLE001 - refuse-over-lie IS the
+                # contract: degraded/typed errors are a served "no", only
+                # a wrong VERIFIED answer is a violation
+                with self._mtx:
+                    self.queries += 1
+                    self.refused += 1
+            else:
+                with self._mtx:
+                    self.queries += 1
+                    self.served += 1
+                    self.verdicts[verdict] = self.verdicts.get(verdict, 0) + 1
+                self.auditor.note_light_answer(
+                    lb.height, lb.hash(), f"client {c} verdict={verdict}")
+            self._stop.wait(0.02 + 0.05 * rng.random())
+
+    def stop(self) -> None:
+        self._stop.set()
+        for th in self._threads:
+            th.join(timeout=5.0)
+
+    def stats(self) -> dict:
+        with self._mtx:
+            out = {"clients": self.n_clients, "queries": self.queries,
+                   "served": self.served, "refused": self.refused,
+                   "verdicts": dict(self.verdicts)}
+        if self.gateway is not None:
+            d = self.gateway.describe()
+            out["gateway"] = {"counters": d["counters"],
+                              "evicted": d["providers"]["evicted"],
+                              "rebuilds": d["counters"]["rebuilds"]}
+        return out
+
+
 # --- the driver --------------------------------------------------------------
 
 
@@ -656,6 +848,7 @@ class SoakReport:
     txs_submitted: int = 0
     actions_fired: int = 0
     violations: list = field(default_factory=list)
+    light: dict = field(default_factory=dict)  # lightcrowd serving stats
     repro: str = ""
 
     @property
@@ -700,6 +893,7 @@ class SoakDriver:
         # rules — a global clear would wipe overlapping faults early, and
         # nemesis.heal() deliberately leaves link rules standing
         self._pending_heals: list[tuple[float, str, object]] = []
+        self._crowds: list[LightCrowd] = []
         self.txs = 0
         self.fired = 0
 
@@ -831,6 +1025,16 @@ class SoakDriver:
                 self.cluster.set_skew(idx, float(secs))
                 if a.dur_s > 0:
                     self._pending_heals.append((now + a.dur_s, "unskew", idx))
+        elif a.kind == "lightcrowd":
+            crowd = LightCrowd(self.cluster, self.auditor,
+                               n_clients=int(a.arg or "8"),
+                               seed=self.seed + self.fired,
+                               logger=self.logger)
+            crowd.start()
+            self._crowds.append(crowd)
+            if a.dur_s > 0:
+                self._pending_heals.append((now + a.dur_s, "crowd_stop",
+                                            crowd))
 
     def _crash(self, victims: list[int], downtime: float, now: float,
                tear: str = "") -> None:
@@ -916,6 +1120,8 @@ class SoakDriver:
                 elif what == "unskew":
                     if payload in self.cluster.nodes:
                         self.cluster.set_skew(payload, 0.0)
+                elif what == "crowd_stop":
+                    payload.stop()
             except Exception as e:  # noqa: BLE001 - a failed relink is a
                 # finding, not a crashed soak: record it and keep driving
                 self.auditor._record("audit", f"{what} failed: {e}")
@@ -952,6 +1158,8 @@ class SoakDriver:
                         self.txs += 1
                 time.sleep(0.05)
         finally:
+            for crowd in self._crowds:
+                crowd.stop()
             self.auditor.stop()
         # final synchronous drain + full-prefix audit (belt over the
         # incremental braces; also covers commits after the last sweep)
@@ -973,6 +1181,15 @@ class SoakDriver:
             txs_submitted=self.txs, actions_fired=self.fired,
             violations=[str(v) for v in self.auditor.violations],
         )
+        if self._crowds:
+            crowds = [c.stats() for c in self._crowds]
+            report.light = {
+                "crowds": crowds,
+                "queries": sum(c["queries"] for c in crowds),
+                "served": sum(c["served"] for c in crowds),
+                "refused": sum(c["refused"] for c in crowds),
+                "answers_audited": self.auditor.light_answers_audited,
+            }
         report.repro = repro_line(self.seed, self.cluster.n_initial,
                                   self.cluster.topology, self.duration_s,
                                   report.schedule,

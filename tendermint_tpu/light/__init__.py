@@ -8,9 +8,12 @@
  - store: DB-backed trusted store
  - range_verify: whole-chain sequential verification in ONE BatchVerifier
    flush (BASELINE config 3: 10k headers -> one TPU kernel launch)
+ - gateway: LightGateway serving many concurrent clients (verified-answer
+   cache, provider failover/hedging/scoreboard, typed degradation)
 """
 
 from tendermint_tpu.light.client import SEQUENTIAL, SKIPPING, Client, TrustOptions
+from tendermint_tpu.light.gateway import ErrGatewayDegraded, LightGateway
 from tendermint_tpu.light.provider import (
     HTTPProvider,
     MockProvider,
@@ -31,6 +34,8 @@ from tendermint_tpu.light.verifier import (
 __all__ = [
     "Client",
     "TrustOptions",
+    "LightGateway",
+    "ErrGatewayDegraded",
     "SEQUENTIAL",
     "SKIPPING",
     "Provider",

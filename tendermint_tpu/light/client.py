@@ -106,6 +106,8 @@ class Client:
         # Divergence records): the live-attack harness reads the built
         # evidence from here after ErrConflictingHeaders surfaces
         self.divergences: list = []
+        # dedup keys for Divergence records: (witness identity, header hash)
+        self._divergence_keys: set = set()
         self.latest_trusted: LightBlock | None = trusted_store.latest_light_block()
         if self.latest_trusted is None:
             self._initialize(trust_options)
@@ -377,4 +379,33 @@ class Client:
             raise
 
     def remove_witness(self, idx: int) -> None:
-        self.witnesses.pop(idx)
+        """Drop the witness at idx; tolerant of a concurrent removal having
+        already shrunk the list (locked — indices are only meaningful under
+        the verification lock)."""
+        with self._mtx:
+            if 0 <= idx < len(self.witnesses):
+                self.witnesses.pop(idx)
+
+    def remove_witnesses(self, providers) -> None:
+        """Identity-based removal: each provider leaves the witness list at
+        most once, regardless of how indices shifted since the caller
+        observed them."""
+        with self._mtx:
+            seen: set[int] = set()
+            for w in providers:
+                if id(w) in seen:
+                    continue
+                seen.add(id(w))
+                for i, cur in enumerate(self.witnesses):
+                    if cur is w:
+                        self.witnesses.pop(i)
+                        break
+
+    def add_witness(self, provider: Provider) -> None:
+        """Rotate a fresh witness in (gateway witness rotation on
+        ErrNoWitnesses)."""
+        with self._mtx:
+            if provider is not self.primary and \
+                    all(w is not provider for w in self.witnesses):
+                self.witnesses.append(provider)
+                self.had_witnesses = True

@@ -9,6 +9,7 @@ slashing), and the lying witness is dropped.
 
 from __future__ import annotations
 
+import contextlib
 from dataclasses import dataclass
 
 from tendermint_tpu.light.provider import (
@@ -29,13 +30,23 @@ class ErrNoWitnesses(Exception):
 class ErrConflictingHeaders(Exception):
     """A witness reported a different header (reference: light/errors.go:88)."""
 
-    def __init__(self, block: LightBlock, witness_index: int):
+    def __init__(self, block: LightBlock, witness_index: int, witness=None):
         self.block = block
         self.witness_index = witness_index
+        # the provider object itself: removal is identity-based so that a
+        # concurrent witness-list mutation cannot redirect the index onto an
+        # innocent witness
+        self.witness = witness
         super().__init__(
             f"header hash ({block.hash().hex()}) from witness {witness_index} "
             "does not match primary"
         )
+
+
+def _client_lock(client):
+    """The client's verification lock when it has one (detect_divergence may
+    be driven directly by harnesses holding only a bare stub client)."""
+    return getattr(client, "_mtx", None) or contextlib.nullcontext()
 
 
 @dataclass
@@ -73,30 +84,54 @@ def detect_divergence(client, new_lb: LightBlock, now: Time) -> None:
 
     A client configured WITH witnesses must never silently continue once all
     of them are dead/removed (reference returns ErrNoWitnesses); a client
-    explicitly configured with zero witnesses skips detection."""
-    if not client.witnesses:
+    explicitly configured with zero witnesses skips detection.
+
+    Runs under the client's verification lock and works over a snapshot of
+    the witness list: two threads driving detection through one shared
+    Client serialize here, and removal is by provider identity, so a
+    witness can be removed at most once and a Divergence recorded at most
+    once per (witness, conflicting header)."""
+    with _client_lock(client):
+        _detect_divergence_locked(client, new_lb, now)
+
+
+def _detect_divergence_locked(client, new_lb: LightBlock, now: Time) -> None:
+    witnesses = list(client.witnesses)
+    if not witnesses:
         if getattr(client, "had_witnesses", False):
             raise ErrNoWitnesses("no witnesses connected. falling back to primary alone")
         return
     sh = new_lb.signed_header
     conflicts: list[ErrConflictingHeaders] = []
-    dead: list[int] = []
-    for i, w in enumerate(client.witnesses):
+    dead: list = []
+    for i, w in enumerate(witnesses):
         try:
             lb = w.light_block(sh.height)
         except ErrHeightTooHigh:
             continue  # witness hasn't caught up yet — not evidence of lying
         except (ErrLightBlockNotFound, ProviderError):
-            dead.append(i)
+            dead.append(w)
             continue
         if lb.hash() != sh.hash():
-            conflicts.append(ErrConflictingHeaders(lb, i))
+            conflicts.append(ErrConflictingHeaders(lb, i, witness=w))
 
     substantiated = [c for c in conflicts
                      if _handle_conflicting_headers(client, c, new_lb, now)]
-    for i in reversed(sorted(set(dead + [c.witness_index for c in conflicts]))):
-        if i < len(client.witnesses):
-            client.remove_witness(i)
+    # optional observer (the gateway's provider scoreboard). Three removal
+    # reasons: "dead" (unresponsive — demotion material), "divergent" (a
+    # conflicting header the witness could NOT substantiate — it lied),
+    # and "substantiated" (the witness PROVED its divergent chain: it is
+    # the whistleblower, the primary's chain is in question — do not
+    # punish it for telling the truth)
+    hook = getattr(client, "on_witness_removed", None)
+    if hook is not None:
+        sub_ids = {id(c) for c in substantiated}
+        for w in dead:
+            hook(w, "dead")
+        for c in conflicts:
+            hook(c.witness,
+                 "substantiated" if id(c) in sub_ids else "divergent")
+    _remove_witnesses(client, dead + [c.witness for c in conflicts])
     if substantiated:
         # The reference errors out so the caller re-examines trust
         # (light/detector.go:95-113); surface the first substantiated
@@ -105,6 +140,24 @@ def detect_divergence(client, new_lb: LightBlock, now: Time) -> None:
         # lying witness must not fail an otherwise-valid verification
         # (reference: light/detector.go:105-110).
         raise substantiated[0]
+
+
+def _remove_witnesses(client, providers) -> None:
+    """Remove each provider from the client's witness list at most once,
+    by identity (a concurrently mutated list can shift indices; popping by
+    stale index would evict an innocent witness)."""
+    if hasattr(client, "remove_witnesses"):
+        client.remove_witnesses(providers)
+        return
+    seen: set[int] = set()
+    for w in providers:
+        if id(w) in seen:
+            continue
+        seen.add(id(w))
+        for i, cur in enumerate(client.witnesses):
+            if cur is w:
+                client.remove_witness(i)
+                break
 
 
 def _substantiate(client, witness, common: LightBlock, target: LightBlock,
@@ -127,7 +180,9 @@ def _handle_conflicting_headers(client, conflict: ErrConflictingHeaders,
     witness substantiated its conflicting header (reference:
     light/detector.go:116 compareNewHeaderWithWitness +
     examineConflictingHeaderAgainstTrace)."""
-    witness = client.witnesses[conflict.witness_index]
+    witness = conflict.witness
+    if witness is None:
+        witness = client.witnesses[conflict.witness_index]
     common = client.latest_trusted
     if common is None or common.height >= primary_block.height:
         common = client.trusted_store.light_block_before(primary_block.height)
@@ -151,10 +206,16 @@ def _handle_conflicting_headers(client, conflict: ErrConflictingHeaders,
         common, primary_block, witness_block.signed_header)
     # record the substantiated divergence on the client so callers (and
     # the live-attack harness) can inspect/resubmit the evidence after the
-    # ErrConflictingHeaders surfaces
+    # ErrConflictingHeaders surfaces; deduped per (witness, conflicting
+    # header) so re-detection never double-records
     if hasattr(client, "divergences"):
-        client.divergences.append(Divergence(
-            conflict.witness_index, ev_against_primary, ev_against_witness))
+        key = (id(witness), witness_block.hash())
+        keys = getattr(client, "_divergence_keys", None)
+        if keys is None or key not in keys:
+            client.divergences.append(Divergence(
+                conflict.witness_index, ev_against_primary, ev_against_witness))
+            if keys is not None:
+                keys.add(key)
     for ev, target in ((ev_against_witness, client.primary),
                        (ev_against_primary, witness)):
         if ev is None:

@@ -1,0 +1,644 @@
+"""Light-client serving gateway: verified-or-refused answers at crowd scale.
+
+One LightGateway fronts many concurrent light clients with three planes:
+
+* **Verified-answer plane** — a bounded cache keyed by height, populated
+  only from ``verify_light_block``-accepted results (quarantined or
+  unverified data can never enter it).  Concurrent queries for the same
+  height coalesce into one in-flight verification (single-flight), whose
+  commit checks batch through the continuous verify service
+  (crypto/verify_service.py) when ``TMTPU_VERIFY_SERVICE=1`` — N clients
+  cost one skip-sequence, not N.  Tx-proof queries are served off the
+  self-healing stores: a typed-corruption read refuses (never serves
+  corrupt bytes) and leaves healing to the scrub/repair plane.
+* **Provider resilience** — per-provider retry with jittered exponential
+  backoff behind the canonical ``light.gateway.fetch`` fault site, hedged
+  secondary requests when the primary exceeds the latency budget, and a
+  provider scoreboard mirroring utils/peerscore.py decay/ban discipline:
+  slow providers are demoted (deprioritized while their decayed score is
+  hot), lying ones (a header failing validation, or contradicting a
+  witness in a substantiated divergence) are evicted permanently.
+  Witness rotation pulls spares in on ErrNoWitnesses.
+* **Typed degradation** — when fresh verification is impossible the
+  gateway serves a stale-but-verified block within the trusting period,
+  else refuses with :class:`ErrGatewayDegraded`.  A wrong answer is never
+  an option; the lightcrowd soak invariant (e2e/soak.py) asserts exactly
+  that under churn, bitrot, and live lunatic attacks.
+
+docs/LIGHT.md has the architecture, verdict table and cookbook.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from collections import OrderedDict
+
+from tendermint_tpu.light.client import Client, TrustOptions
+from tendermint_tpu.light.detector import ErrConflictingHeaders, ErrNoWitnesses
+from tendermint_tpu.light.provider import (
+    ErrBadLightBlock,
+    ErrHeightTooHigh,
+    ErrLightBlockNotFound,
+    ErrNoResponse,
+    Provider,
+    ProviderError,
+)
+from tendermint_tpu.light.store import DBStore
+from tendermint_tpu.light.verifier import header_expired
+from tendermint_tpu.store.envelope import CorruptedStoreError
+from tendermint_tpu.types.light_block import LightBlock
+from tendermint_tpu.types.ttime import Time
+from tendermint_tpu.utils import faults, trace
+from tendermint_tpu.utils.faults import FaultError
+from tendermint_tpu.utils.peerscore import (
+    SANCTION_NONE,
+    PeerScoreBoard,
+    ScoreConfig,
+)
+
+# Serving verdicts: every successful answer names how it was produced.
+VERDICT_FRESH = "fresh"          # verified on this request
+VERDICT_CACHED = "cached"        # bounded verified-answer cache hit
+VERDICT_COALESCED = "coalesced"  # rode another client's in-flight verification
+VERDICT_STALE = "stale"          # previously verified, within trust period,
+                                 # served because fresh verification failed
+
+# Offense points against the gateway scoreboard (same shape as
+# utils/peerscore.py OFFENSE_POINTS; the ScoreConfig below maps 50 ->
+# demotion and 100 -> ban, so one lying offense evicts immediately while
+# slowness has to accumulate faster than the halflife decays it).
+GATEWAY_OFFENSE_POINTS: dict[str, float] = {
+    "slow_response": 10.0,
+    "no_response": 25.0,
+    "bad_light_block": 100.0,
+    "conflicting_header": 100.0,
+}
+
+# Offenses that prove dishonesty rather than slowness: permanent eviction.
+LYING_OFFENSES = frozenset({"bad_light_block", "conflicting_header"})
+
+FETCH_SITE = "light.gateway.fetch"
+
+
+class ErrGatewayDegraded(Exception):
+    """The gateway cannot produce a verified answer and refuses to guess."""
+
+    def __init__(self, reason: str):
+        self.reason = reason
+        super().__init__(f"gateway degraded: {reason}")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class GatewayConfig:
+    """Env-tunable knobs (documented in docs/CONFIG.md)."""
+
+    def __init__(self):
+        self.retries = _env_int("TMTPU_GATEWAY_RETRIES", 2)
+        self.backoff_s = _env_float("TMTPU_GATEWAY_BACKOFF_S", 0.05)
+        self.hedge_s = _env_float("TMTPU_GATEWAY_HEDGE_S", 0.25)
+        self.cache_cap = _env_int("TMTPU_GATEWAY_CACHE", 1024)
+        self.n_witnesses = _env_int("TMTPU_GATEWAY_WITNESSES", 2)
+
+
+class ProviderScoreBoard:
+    """Provider health ledger mirroring utils/peerscore.py discipline:
+    decaying scores with a halflife, a demotion threshold (slow providers
+    sink in the fetch order until the decay forgives them), a scored-ban
+    threshold, and permanent eviction for provably lying providers."""
+
+    def __init__(self, clock=time.monotonic):
+        self._board = PeerScoreBoard(
+            ScoreConfig(halflife_s=120.0, disconnect_score=50.0,
+                        ban_score=100.0, ban_duration_s=60.0,
+                        ban_max_duration_s=600.0),
+            clock=clock,
+        )
+        self._mtx = threading.Lock()
+        self._lying: set[str] = set()
+        self.evictions = 0
+
+    def record(self, name: str, offense: str) -> str:
+        sanction = self._board.record(
+            name, offense, GATEWAY_OFFENSE_POINTS.get(offense, 1.0))
+        if offense in LYING_OFFENSES:
+            with self._mtx:
+                if name not in self._lying:
+                    self._lying.add(name)
+                    self.evictions += 1
+            return "evict"
+        return sanction if sanction != SANCTION_NONE else "none"
+
+    def evicted(self, name: str) -> bool:
+        with self._mtx:
+            if name in self._lying:
+                return True
+        return self._board.is_banned(name)
+
+    def demoted(self, name: str) -> bool:
+        return self._board.score(name) >= self._board.config.disconnect_score
+
+    def rank(self, name: str) -> tuple:
+        """Sort key: evicted last (callers filter them anyway), demoted
+        after healthy, then by decayed score ascending."""
+        return (self.evicted(name), self.demoted(name), self._board.score(name))
+
+    def describe(self) -> dict:
+        d = self._board.describe()
+        with self._mtx:
+            d["evicted"] = sorted(self._lying)
+            d["evictions"] = self.evictions
+        return d
+
+
+class _GatewayProvider(Provider):
+    """Wraps a raw provider so every fetch the inner Client makes flows
+    through the gateway's instrumented path (fault site, retry/backoff,
+    hedging, scoring)."""
+
+    def __init__(self, gateway: "LightGateway", name: str, inner: Provider):
+        self.gateway = gateway
+        self.name = name
+        self.inner = inner
+
+    def chain_id(self) -> str:
+        return self.inner.chain_id()
+
+    def light_block(self, height: int) -> LightBlock:
+        return self.gateway._fetch(self, height)
+
+    def report_evidence(self, ev) -> None:
+        self.inner.report_evidence(ev)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<gateway provider {self.name}>"
+
+
+class LightGateway:
+    """A witness/provider gateway serving many concurrent light clients.
+
+    ``providers`` is an ordered pool: the first becomes the inner client's
+    primary, the next ``TMTPU_GATEWAY_WITNESSES`` its witnesses, the rest
+    spares used for hedged secondaries and witness rotation.  ``node``
+    (optional) attaches a local full node for tx-proof queries off its
+    self-healing stores.  ``clock``/``sleep`` are injectable for tests.
+    """
+
+    def __init__(
+        self,
+        chain_id: str,
+        trust_options: TrustOptions,
+        providers: list[Provider],
+        trusted_store: DBStore,
+        *,
+        provider_names: list[str] | None = None,
+        node=None,
+        config: GatewayConfig | None = None,
+        clock=time.monotonic,
+        sleep=time.sleep,
+        seed: int = 0,
+        logger=None,
+    ):
+        if not providers:
+            raise ValueError("gateway needs at least one provider")
+        self.chain_id = chain_id
+        self.node = node
+        self.config = config if config is not None else GatewayConfig()
+        self.logger = logger
+        self._clock = clock
+        self._sleep = sleep
+        self._trust_options = trust_options
+        self._rng = random.Random(f"gateway:{seed}")
+        self.scoreboard = ProviderScoreBoard(clock=clock)
+
+        names = provider_names or [f"p{i}" for i in range(len(providers))]
+        if len(names) != len(providers):
+            raise ValueError("provider_names must match providers")
+        self._pool = [_GatewayProvider(self, n, p)
+                      for n, p in zip(names, providers)]
+        self._spares: list[_GatewayProvider] = []
+        self._store = trusted_store
+        self.client: Client | None = None  # set by _build_client
+        self.divergences: list = []
+        self._stat = threading.Lock()
+        self.rebuilds = 0
+        self.rotations = 0
+
+        # bounded verified-answer cache: height -> LightBlock, inserted
+        # only from verify_light_block-accepted results
+        self._cache: OrderedDict[int, LightBlock] = OrderedDict()
+        self._cache_mtx = threading.Lock()
+        # single-flight: height -> Event of the leading verification
+        self._flight: dict[int, threading.Event] = {}
+        self._flight_mtx = threading.Lock()
+
+        self.queries = 0
+        self.cache_hits = 0
+        self.coalesced = 0
+        self.stale_served = 0
+        self.refused = 0
+        self.hedges = 0
+        self.retries = 0
+
+        # last: building the client fetches + verifies the trust anchor
+        # through the instrumented fetch plane above
+        self._build_client()
+
+    # --- provider pool -----------------------------------------------------
+
+    def _build_client(self) -> None:
+        old = self.client
+        store = old.trusted_store if old is not None else self._store
+        for _ in range(len(self._pool)):
+            alive = [w for w in self._pool
+                     if not self.scoreboard.evicted(w.name)]
+            if not alive:
+                break
+            alive.sort(key=lambda w: self.scoreboard.rank(w.name))
+            k = max(0, self.config.n_witnesses)
+            primary, witnesses = alive[0], alive[1:1 + k]
+            self._spares = alive[1 + k:]
+            try:
+                self.client = Client(
+                    self.chain_id, self._trust_options, primary, witnesses,
+                    store, logger=self.logger,
+                )
+            except ErrConflictingHeaders as e:
+                # a witness contradicted the TRUST ANCHOR at construction:
+                # that provider is lying about pinned history — evict it
+                # and rebuild around the rest
+                liar = getattr(e, "witness", None) or (
+                    witnesses[e.witness_index]
+                    if 0 <= e.witness_index < len(witnesses) else primary)
+                self.scoreboard.record(liar.name, "conflicting_header")
+                continue
+            self.client.on_witness_removed = self._witness_removed
+            if old is not None:
+                self.divergences.extend(old.divergences)
+                with self._stat:
+                    self.rebuilds += 1
+            return
+        raise ErrGatewayDegraded("every provider is evicted")
+
+    def _witness_removed(self, wrapper, reason: str) -> None:
+        """Detector hook (light/detector.py): witnesses the cross-check
+        drops feed the scoreboard — an UNSUBSTANTIATED divergent header is
+        lying (evict); a dead witness is demoted under the decay/ban
+        discipline; a witness that SUBSTANTIATED its divergence is the
+        whistleblower (the conflict handler deals with the primary) and
+        takes no offense — the next rebuild re-seats it."""
+        name = getattr(wrapper, "name", None)
+        if name is None or reason == "substantiated":
+            return
+        self.scoreboard.record(
+            name, "conflicting_header" if reason == "divergent"
+            else "no_response")
+
+    def _rotate_witnesses(self) -> bool:
+        """On ErrNoWitnesses pull fresh non-evicted spares into the
+        client's witness rotation; True iff any joined."""
+        added = False
+        while self._spares and len(self.client.witnesses) < self.config.n_witnesses:
+            w = self._spares.pop(0)
+            if self.scoreboard.evicted(w.name) or w is self.client.primary:
+                continue
+            self.client.add_witness(w)
+            added = True
+        if added:
+            with self._stat:
+                self.rotations += 1
+        return added
+
+    # --- fetch plane (retry/backoff/hedging/scoring) -----------------------
+
+    def _fetch(self, wrapper: _GatewayProvider, height: int) -> LightBlock:
+        spare = next(
+            (s for s in self._spares
+             if s is not wrapper and not self.scoreboard.evicted(s.name)),
+            None)
+        with trace.span(FETCH_SITE, provider=wrapper.name):
+            if spare is None:
+                return self._attempts(wrapper, height)
+            return self._hedged(wrapper, spare, height)
+
+    def _attempts(self, wrapper: _GatewayProvider, height: int,
+                  score_slow: bool = True) -> LightBlock:
+        """Per-provider retry loop with jittered exponential backoff."""
+        cfg = self.config
+        last: Exception | None = None
+        for attempt in range(cfg.retries + 1):
+            if attempt:
+                with self._stat:
+                    self.retries += 1
+                self._sleep(cfg.backoff_s * (2 ** (attempt - 1))
+                            * (0.5 + self._rng.random()))
+            t0 = self._clock()
+            try:
+                faults.fire(FETCH_SITE)
+                lb = wrapper.inner.light_block(height)
+            except (ErrHeightTooHigh, ErrLightBlockNotFound):
+                raise  # typed, deterministic answers: retrying cannot help
+            except (ProviderError, FaultError, OSError) as e:
+                self.scoreboard.record(wrapper.name, "no_response")
+                last = e
+                continue
+            if score_slow and self._clock() - t0 > cfg.hedge_s:
+                self.scoreboard.record(wrapper.name, "slow_response")
+            try:
+                lb.validate_basic(self.chain_id)
+            except Exception as e:
+                # malformed data is lying, not slowness: evict
+                self.scoreboard.record(wrapper.name, "bad_light_block")
+                raise ErrBadLightBlock(
+                    f"provider {wrapper.name} returned an invalid light "
+                    f"block: {e}") from e
+            return lb
+        raise last if last is not None else ErrNoResponse(
+            f"provider {wrapper.name} kept failing")
+
+    def _hedged(self, wrapper: _GatewayProvider, spare: _GatewayProvider,
+                height: int) -> LightBlock:
+        """Race the primary's retry sequence against a hedged secondary
+        launched once the latency budget is exceeded."""
+        state: dict = {"errs": [], "pending": 1}
+        cond = threading.Condition()
+
+        def run(w: _GatewayProvider, score_slow: bool) -> None:
+            try:
+                lb = self._attempts(w, height, score_slow=score_slow)
+                err = None
+            except Exception as e:  # noqa: BLE001 - collected and re-raised
+                lb, err = None, e
+            with cond:
+                state["pending"] -= 1
+                if lb is not None and "ok" not in state:
+                    state["ok"] = lb
+                if err is not None:
+                    state["errs"].append(err)
+                cond.notify_all()
+
+        t = threading.Thread(target=run, args=(wrapper, False), daemon=True,
+                             name=f"gw-fetch-{wrapper.name}")
+        t.start()
+        with cond:
+            cond.wait_for(lambda: "ok" in state or state["pending"] == 0,
+                          timeout=self.config.hedge_s)
+            if "ok" in state:
+                return state["ok"]
+            if state["pending"] == 0:
+                raise state["errs"][0]
+            state["pending"] += 1
+        # budget blown: primary is slow; fire the hedge
+        trace.mark("light.gateway.hedge")
+        with self._stat:
+            self.hedges += 1
+        self.scoreboard.record(wrapper.name, "slow_response")
+        t2 = threading.Thread(target=run, args=(spare, True), daemon=True,
+                              name=f"gw-hedge-{spare.name}")
+        t2.start()
+        with cond:
+            cond.wait_for(lambda: "ok" in state or state["pending"] == 0)
+            if "ok" in state:
+                return state["ok"]
+            raise state["errs"][0] if state["errs"] else ErrNoResponse(
+                "hedged fetch failed")
+
+    # --- verified-answer plane ---------------------------------------------
+
+    def _cache_get(self, height: int) -> LightBlock | None:
+        with self._cache_mtx:
+            lb = self._cache.get(height)
+            if lb is not None:
+                self._cache.move_to_end(height)
+            return lb
+
+    def _cache_put(self, lb: LightBlock) -> None:
+        with self._cache_mtx:
+            self._cache[lb.height] = lb
+            self._cache.move_to_end(lb.height)
+            while len(self._cache) > max(1, self.config.cache_cap):
+                self._cache.popitem(last=False)
+
+    def serve_light_block(self, height: int,
+                          now: Time | None = None) -> tuple[LightBlock, str]:
+        """Serve a verified light block at ``height``; returns
+        ``(light_block, verdict)`` or raises :class:`ErrGatewayDegraded`
+        (or a typed provider error for unknown heights).  Never returns
+        anything that did not pass light-client verification."""
+        if now is None:
+            now = Time.now()
+        with trace.span("light.gateway.serve", height=height):
+            with self._stat:
+                self.queries += 1
+            lb = self._cache_get(height)
+            if lb is not None:
+                with self._stat:
+                    self.cache_hits += 1
+                return lb, VERDICT_CACHED
+            while True:
+                with self._flight_mtx:
+                    ev = self._flight.get(height)
+                    if ev is None:
+                        self._flight[height] = ev = threading.Event()
+                        break
+                ev.wait(timeout=60.0)
+                lb = self._cache_get(height)
+                if lb is not None:
+                    with self._stat:
+                        self.coalesced += 1
+                    return lb, VERDICT_COALESCED
+                # the leader failed; loop and try to lead ourselves
+            try:
+                lb = self._verify_height(height, now)
+                self._cache_put(lb)
+                return lb, VERDICT_FRESH
+            except Exception as e:
+                stale = self._stale_answer(height, now)
+                if stale is not None:
+                    with self._stat:
+                        self.stale_served += 1
+                    self._cache_put(stale)
+                    return stale, VERDICT_STALE
+                with self._stat:
+                    self.refused += 1
+                if isinstance(e, (ErrGatewayDegraded, ErrHeightTooHigh,
+                                  ErrLightBlockNotFound)):
+                    raise
+                raise ErrGatewayDegraded(str(e)) from e
+            finally:
+                with self._flight_mtx:
+                    self._flight.pop(height, None)
+                ev.set()
+
+    def serve_latest(self, now: Time | None = None) -> tuple[LightBlock, str]:
+        """Serve the latest verified light block, refreshing from the
+        providers first.  When no provider can produce a fresh verified
+        head, degrade to the latest stale-but-verified block within the
+        trusting period, else refuse with :class:`ErrGatewayDegraded`."""
+        if now is None:
+            now = Time.now()
+        with trace.span("light.gateway.serve", height=0):
+            with self._stat:
+                self.queries += 1
+            try:
+                lb = self.client.update(now)
+                if lb is None:
+                    lb = self.client.latest_trusted
+                self._cache_put(lb)
+                return lb, VERDICT_FRESH
+            except Exception as e:
+                latest = self.client.latest_trusted
+                if latest is not None and not header_expired(
+                        latest.signed_header, self.client.trusting_period_s,
+                        now):
+                    with self._stat:
+                        self.stale_served += 1
+                    return latest, VERDICT_STALE
+                with self._stat:
+                    self.refused += 1
+                raise ErrGatewayDegraded(
+                    f"no fresh head and trusted state expired: {e}") from e
+
+    def _stale_answer(self, height: int, now: Time) -> LightBlock | None:
+        """A previously verified block at this height, iff still inside
+        the trusting period (typed degradation: stale-but-verified)."""
+        lb = self.client.trusted_store.light_block(height)
+        if lb is None:
+            return None
+        if header_expired(lb.signed_header, self.client.trusting_period_s, now):
+            return None
+        return lb
+
+    def _verify_height(self, height: int, now: Time) -> LightBlock:
+        last: Exception | None = None
+        for _ in range(2):
+            try:
+                return self.client.verify_light_block_at_height(height, now)
+            except ErrConflictingHeaders as e:
+                # A witness substantiated a divergent header: the primary
+                # is contradicted by a provable chain. The detector already
+                # built + reported the evidence both ways; evict the
+                # primary, rebuild around the witness set, retry once.
+                last = e
+                self.scoreboard.record(self.client.primary.name,
+                                       "conflicting_header")
+                self._build_client()
+            except ErrNoWitnesses as e:
+                last = e
+                if not self._rotate_witnesses():
+                    raise
+        raise last if last is not None else ErrGatewayDegraded(
+            "verification kept failing")
+
+    # --- tx-proof plane ------------------------------------------------------
+
+    def serve_tx(self, tx_hash_bytes: bytes,
+                 now: Time | None = None) -> dict:
+        """Tx lookup + Merkle inclusion proof verified against the
+        gateway-verified header at that height, off the attached node's
+        self-healing stores.  A typed-corruption read refuses — corrupt
+        bytes are never served; the scrub/repair plane heals the row."""
+        if self.node is None:
+            raise ErrGatewayDegraded("no local node attached for tx queries")
+        indexer = getattr(self.node, "tx_indexer", None)
+        if indexer is None:
+            raise ErrGatewayDegraded("transaction indexing is disabled")
+        from tendermint_tpu.types.tx import tx_hash, txs_proof
+
+        try:
+            res = indexer.get(tx_hash_bytes)
+            if res is None:
+                raise ErrLightBlockNotFound(
+                    f"tx ({tx_hash_bytes.hex()}) not found")
+            height, idx = int(res["height"]), int(res["index"])
+            block = self.node.block_store.load_block(height)
+        except CorruptedStoreError as e:
+            with self._stat:
+                self.refused += 1
+            raise ErrGatewayDegraded(
+                f"store row quarantined, refusing to serve: {e}") from e
+        if block is None:
+            with self._stat:
+                self.refused += 1
+            raise ErrGatewayDegraded(
+                f"block at height {height} unavailable for proof")
+        txs = list(block.data.txs)
+        root, proof = txs_proof(txs, idx)
+        lb, verdict = self.serve_light_block(height, now)
+        if root != lb.signed_header.header.data_hash:
+            # the local store disagrees with the verified chain: refuse
+            with self._stat:
+                self.refused += 1
+            raise ErrGatewayDegraded(
+                "tx proof root does not match the verified header")
+        proof.verify(root, tx_hash(txs[idx]))
+        return {
+            "height": height,
+            "index": idx,
+            "tx": txs[idx],
+            "root_hash": root,
+            "proof": proof,
+            "verdict": verdict,
+        }
+
+    # --- introspection -------------------------------------------------------
+
+    def describe(self) -> dict:
+        with self._stat:
+            counters = {
+                "queries": self.queries,
+                "cache_hits": self.cache_hits,
+                "coalesced": self.coalesced,
+                "stale_served": self.stale_served,
+                "refused": self.refused,
+                "hedges": self.hedges,
+                "retries": self.retries,
+                "rebuilds": self.rebuilds,
+                "rotations": self.rotations,
+            }
+        with self._cache_mtx:
+            cache = {"size": len(self._cache),
+                     "cap": self.config.cache_cap}
+        latest = self.client.latest_trusted
+        return {
+            "chain_id": self.chain_id,
+            "latest_trusted": latest.height if latest is not None else 0,
+            "primary": self.client.primary.name,
+            "witnesses": [w.name for w in self.client.witnesses],
+            "spares": [s.name for s in self._spares],
+            "counters": counters,
+            "cache": cache,
+            "providers": self.scoreboard.describe(),
+            "divergences": len(self.divergences) + len(self.client.divergences),
+        }
+
+    def all_divergences(self) -> list:
+        return list(self.divergences) + list(self.client.divergences)
+
+
+__all__ = [
+    "ErrGatewayDegraded",
+    "GatewayConfig",
+    "GATEWAY_OFFENSE_POINTS",
+    "LightGateway",
+    "ProviderScoreBoard",
+    "VERDICT_CACHED",
+    "VERDICT_COALESCED",
+    "VERDICT_FRESH",
+    "VERDICT_STALE",
+]

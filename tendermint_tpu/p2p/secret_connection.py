@@ -26,11 +26,18 @@ import socket
 import struct
 import threading
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+except ModuleNotFoundError:  # image without `cryptography`: RFC-exact fallback
+    from tendermint_tpu.crypto.purecrypto import (
+        ChaCha20Poly1305,
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
 
 from tendermint_tpu.crypto import ed25519
 from tendermint_tpu.encoding import proto

@@ -6,6 +6,7 @@ response types (amino-style JSON: hex upper-case hashes, stringified ints).
 from __future__ import annotations
 
 import base64
+import os
 import threading
 import time as _time
 
@@ -709,6 +710,106 @@ def light_block(env, height=0):
     return {"height": str(lb.height), "light_block": lb.marshal().hex()}
 
 
+def _light_gateway(env):
+    """The node-local LightGateway (lazily built, cached on the node).
+
+    The primary provider is the node's own self-healing stores; operators
+    can cross-check against peer RPC endpoints via TMTPU_GATEWAY_PEERS
+    (comma-separated base URLs become witness/spare HTTPProviders). Every
+    gateway answer is light-client verified or refused — unlike the raw
+    light_block route, which serves whatever the store (or a byzantine
+    seam) holds."""
+    gw = getattr(env.node, "_light_gateway", None)
+    if gw is not None:
+        return gw
+    from tendermint_tpu.light.gateway import LightGateway, TrustOptions
+    from tendermint_tpu.light.provider import HTTPProvider, NodeProvider
+    from tendermint_tpu.light.store import DBStore
+    from tendermint_tpu.store.db import MemDB
+
+    chain_id = env.node.genesis.chain_id
+    primary = NodeProvider(chain_id, env.node.block_store,
+                           env.node.state_store)
+    providers, names = [primary], ["local"]
+    for url in os.environ.get("TMTPU_GATEWAY_PEERS", "").split(","):
+        url = url.strip()
+        if url:
+            providers.append(HTTPProvider(chain_id, url))
+            names.append(url)
+    base = max(env.node.block_store.base, 1)
+    anchor = primary.light_block(base)
+    opts = TrustOptions(
+        period_s=env.node.config.statesync.trust_period_s,
+        height=anchor.height, hash=anchor.hash())
+    gw = LightGateway(chain_id, opts, providers, DBStore(MemDB(), chain_id),
+                      node=env.node, provider_names=names,
+                      logger=getattr(env.node, "logger", None))
+    env.node._light_gateway = gw
+    return gw
+
+
+def gateway_light_block(env, height=0):
+    """Verified-or-refused light block through the node-local gateway
+    (docs/LIGHT.md). height=0 serves the latest verified head."""
+    from tendermint_tpu.light.gateway import ErrGatewayDegraded
+    from tendermint_tpu.light.provider import (
+        ErrHeightTooHigh,
+        ErrLightBlockNotFound,
+    )
+
+    h = int(height)
+    gw = _light_gateway(env)
+    try:
+        if h == 0:
+            lb, verdict = gw.serve_latest()
+        else:
+            lb, verdict = gw.serve_light_block(h)
+    except ErrHeightTooHigh as e:
+        raise ValueError(
+            f"height {h} must be less than or equal to the current blockchain height"
+        ) from e
+    except ErrLightBlockNotFound as e:
+        raise ValueError(f"could not find block: {e}") from e
+    except ErrGatewayDegraded as e:
+        raise ValueError(str(e)) from e
+    return {"height": str(lb.height), "light_block": lb.marshal().hex(),
+            "verdict": verdict}
+
+
+def gateway_tx(env, hash=""):
+    """Tx + Merkle proof verified against a gateway-verified header; a
+    quarantined store row refuses instead of serving corrupt bytes."""
+    from tendermint_tpu.light.gateway import ErrGatewayDegraded
+    from tendermint_tpu.light.provider import ErrLightBlockNotFound
+
+    raw = base64.b64decode(hash) if isinstance(hash, str) else hash
+    gw = _light_gateway(env)
+    try:
+        res = gw.serve_tx(raw)
+    except ErrLightBlockNotFound as e:
+        raise ValueError(str(e)) from e
+    except ErrGatewayDegraded as e:
+        raise ValueError(str(e)) from e
+    p = res["proof"]
+    return {
+        "height": str(res["height"]),
+        "index": str(res["index"]),
+        "tx": _b64(res["tx"]),
+        "verdict": res["verdict"],
+        "proof": {
+            "root_hash": _hex(res["root_hash"]),
+            "proof": {"total": str(p.total), "index": str(p.index),
+                      "leaf_hash": _b64(p.leaf_hash),
+                      "aunts": [_b64(a) for a in p.aunts]},
+        },
+    }
+
+
+def gateway_status(env):
+    """Gateway introspection: provider scoreboard, cache, verdict counters."""
+    return _light_gateway(env).describe()
+
+
 def broadcast_evidence(env, evidence):
     """reference: rpc/core/evidence.go:17 BroadcastEvidence."""
     from tendermint_tpu.types.evidence import evidence_unmarshal
@@ -921,6 +1022,9 @@ ROUTES = {
     "block_results": block_results,
     "commit": commit,
     "light_block": light_block,
+    "gateway_light_block": gateway_light_block,
+    "gateway_tx": gateway_tx,
+    "gateway_status": gateway_status,
     "validators": validators,
     "consensus_params": consensus_params,
     "consensus_state": consensus_state,

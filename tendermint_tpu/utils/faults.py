@@ -127,6 +127,10 @@ CANONICAL_SITES: dict[str, str] = {
                                 "(state.go:1667)",
     "consensus.finalize.done": "crash site 5: after update_to_state "
                                "(state.go:1685)",
+    "light.gateway.fetch": "one provider fetch attempt inside the light "
+                           "gateway (light/gateway.py); raise/delay exercise "
+                           "retry with backoff, hedged secondaries, and "
+                           "provider-scoreboard demotion/failover",
 }
 
 _SPEC_RE = re.compile(

@@ -99,6 +99,14 @@ CANONICAL_SPANS = {
     "store.scrub": "one integrity-scrub pass over a node's stores (span)",
     "store.repair": "peer re-fetch + batch-verified rewrite of one damaged "
                     "height (span; height= tag)",
+    # light-client serving gateway (light/gateway.py, docs/LIGHT.md)
+    "light.gateway.serve": "one client query through the gateway: cache "
+                           "lookup, coalesced verification, answer or "
+                           "typed refusal (span; height= tag)",
+    "light.gateway.fetch": "one provider fetch attempt, including retries "
+                           "(span; provider= tag)",
+    "light.gateway.hedge": "hedged secondary fired after the primary "
+                           "exceeded the latency budget (mark)",
 }
 
 # Spans mirrored into the pre-seeded `trace_phase_seconds{phase=}`

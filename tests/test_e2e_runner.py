@@ -112,7 +112,13 @@ def test_generator_deterministic_and_bounded():
         if m.byzantine_node >= 0:
             assert m.validators >= 4 and m.byzantine_node < m.validators
         for p in m.perturbations:
-            assert p.node < m.validators and p.action in ("kill", "restart", "pause")
+            assert p.node < m.validators
+            assert p.action in ("kill", "restart", "pause", "partition")
+            if p.action == "partition":
+                assert p.groups and all(p.groups)
+        assert m.light_clients in (0, 4, 8, 16)
+    # the light-serving dimension does get rolled somewhere in the matrix
+    assert any(m.light_clients for m in generate(seed=7, count=40))
 
 
 def test_e2e_generated_manifest_runs(tmp_path):

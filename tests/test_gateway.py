@@ -1,0 +1,466 @@
+"""LightGateway: verified-answer cache, single-flight coalescing, provider
+retry/backoff/hedging, scoreboard demotion/eviction, witness rotation, and
+typed degradation verdicts. Detector thread-safety regressions ride here
+too (shared-Client concurrency)."""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+from test_light import (
+    CHAIN_ID,
+    TRUST_PERIOD,
+    _mk_header,
+    _mk_keys,
+    _sign_commit,
+    gen_chain,
+    t,
+)
+
+from tendermint_tpu.light.client import Client, TrustOptions
+from tendermint_tpu.light.detector import detect_divergence
+from tendermint_tpu.light.gateway import (
+    ErrGatewayDegraded,
+    GatewayConfig,
+    LightGateway,
+    VERDICT_CACHED,
+    VERDICT_COALESCED,
+    VERDICT_FRESH,
+    VERDICT_STALE,
+)
+from tendermint_tpu.light.provider import ErrNoResponse, MockProvider
+from tendermint_tpu.light.store import DBStore
+from tendermint_tpu.store.db import MemDB
+from tendermint_tpu.store.envelope import CorruptedStoreError
+from tendermint_tpu.types.light_block import LightBlock, SignedHeader
+from tendermint_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return _mk_keys(4)
+
+
+@pytest.fixture(scope="module")
+def chain(keys):
+    privs, vs = keys
+    return gen_chain(12, privs, vs)
+
+
+def _mock(chain):
+    return MockProvider(CHAIN_ID, {lb.height: lb for lb in chain})
+
+
+def _opts(chain):
+    return TrustOptions(period_s=TRUST_PERIOD, height=1, hash=chain[0].hash())
+
+
+def _gateway(chain, n=3, now=None, **kw):
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("providers", [_mock(chain) for _ in range(n)])
+    return LightGateway(CHAIN_ID, _opts(chain), kw.pop("providers"),
+                        DBStore(MemDB(), CHAIN_ID), **kw)
+
+
+class FlakyProvider(MockProvider):
+    """Fails the first `fail_n` light_block calls with ErrNoResponse."""
+
+    def __init__(self, chain_id, lbs, fail_n):
+        super().__init__(chain_id, lbs)
+        self.fail_n = fail_n
+        self.calls = 0
+
+    def light_block(self, height):
+        self.calls += 1
+        if self.calls <= self.fail_n:
+            raise ErrNoResponse("flaky")
+        return super().light_block(height)
+
+
+class SlowProvider(MockProvider):
+    def __init__(self, chain_id, lbs, delay_s, skip_first=1):
+        super().__init__(chain_id, lbs)
+        self.delay_s = delay_s
+        self.calls = 0
+        self.skip_first = skip_first  # let client init go through fast
+
+    def light_block(self, height):
+        self.calls += 1
+        if self.calls > self.skip_first:
+            time.sleep(self.delay_s)
+        return super().light_block(height)
+
+
+# --- verified-answer plane ---------------------------------------------------
+
+
+def test_serves_verified_and_caches(chain):
+    gw = _gateway(chain)
+    lb, verdict = gw.serve_light_block(8, now=t(100))
+    assert verdict == VERDICT_FRESH
+    assert lb.hash() == chain[7].hash()
+    lb2, verdict2 = gw.serve_light_block(8, now=t(100))
+    assert verdict2 == VERDICT_CACHED
+    assert lb2.hash() == chain[7].hash()
+    assert gw.cache_hits == 1 and gw.queries == 2
+
+
+def test_cache_is_bounded(chain):
+    cfg = GatewayConfig()
+    cfg.cache_cap = 2
+    gw = _gateway(chain, config=cfg)
+    for h in (3, 5, 7, 9):
+        gw.serve_light_block(h, now=t(100))
+    assert len(gw._cache) <= 2
+
+
+def test_concurrent_clients_coalesce(chain):
+    gw = _gateway(chain)
+    results = []
+    errs = []
+    barrier = threading.Barrier(8)
+
+    def client():
+        try:
+            barrier.wait(timeout=10)
+            results.append(gw.serve_light_block(10, now=t(120)))
+        except Exception as e:  # noqa: BLE001 - surfaced via assert
+            errs.append(e)
+
+    threads = [threading.Thread(target=client) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    assert not errs
+    assert len(results) == 8
+    assert all(lb.hash() == chain[9].hash() for lb, _ in results)
+    fresh = [v for _, v in results if v == VERDICT_FRESH]
+    shared = [v for _, v in results
+              if v in (VERDICT_COALESCED, VERDICT_CACHED)]
+    assert len(fresh) == 1
+    assert len(shared) == 7
+
+
+# --- provider resilience -----------------------------------------------------
+
+
+def test_retry_with_backoff_rides_out_transient_failures(chain):
+    flaky = FlakyProvider(CHAIN_ID, {lb.height: lb for lb in chain}, 0)
+    gw = _gateway(chain, providers=[flaky, _mock(chain), _mock(chain)])
+    flaky.fail_n = flaky.calls + 2  # next two primary fetches fail
+    lb, verdict = gw.serve_light_block(6, now=t(100))
+    assert verdict == VERDICT_FRESH
+    assert lb.hash() == chain[5].hash()
+    assert gw.retries >= 1
+    assert gw.scoreboard._board.score("p0") > 0  # no_response offenses
+
+
+def test_fault_site_failures_retry_and_score(chain):
+    gw = _gateway(chain)
+    faults.configure(["light.gateway.fetch:raise@1"], seed=7)
+    lb, verdict = gw.serve_light_block(4, now=t(100))
+    assert verdict == VERDICT_FRESH
+    assert lb.hash() == chain[3].hash()
+    assert gw.retries >= 1
+
+
+def test_hedged_secondary_beats_slow_primary(chain):
+    cfg = GatewayConfig()
+    cfg.hedge_s = 0.05
+    cfg.n_witnesses = 2
+    slow = SlowProvider(CHAIN_ID, {lb.height: lb for lb in chain}, 0.5)
+    providers = [slow, _mock(chain), _mock(chain), _mock(chain)]
+    gw = LightGateway(CHAIN_ID, _opts(chain), providers,
+                      DBStore(MemDB(), CHAIN_ID), config=cfg,
+                      sleep=lambda s: None)
+    t0 = time.monotonic()
+    lb, verdict = gw.serve_light_block(9, now=t(120))
+    assert verdict == VERDICT_FRESH
+    assert lb.hash() == chain[8].hash()
+    assert gw.hedges >= 1
+    assert gw.scoreboard._board.score("p0") > 0  # slow offense recorded
+    assert time.monotonic() - t0 < 3.0
+
+
+def test_lying_primary_evicted_and_recovers(keys, chain):
+    privs, vs = keys
+    fake = gen_chain(12, privs, vs, step_s=20)  # same anchor keys, forked times
+    primary = MockProvider(
+        CHAIN_ID, {1: chain[0], **{lb.height: lb for lb in fake[1:]}})
+    witnesses = [_mock(chain), _mock(chain)]
+    gw = LightGateway(CHAIN_ID, _opts(chain), [primary] + witnesses,
+                      DBStore(MemDB(), CHAIN_ID), sleep=lambda s: None)
+    lb, verdict = gw.serve_light_block(7, now=t(300))
+    # honest answer, lying primary permanently evicted
+    assert lb.hash() == chain[6].hash()
+    assert gw.scoreboard.evicted("p0")
+    assert gw.scoreboard.evictions == 1
+    assert gw.rebuilds == 1
+    assert gw.client.primary.name != "p0"
+    assert gw.all_divergences()
+    # evidence was reported to the (honest) witness provider
+    assert any(w.evidences for w in witnesses)
+    d = gw.describe()
+    assert "p0" in d["providers"]["evicted"]
+
+
+def test_witness_rotation_on_no_witnesses(chain):
+    cfg = GatewayConfig()
+    cfg.n_witnesses = 1
+    dead_witness = MockProvider(CHAIN_ID, {1: chain[0]})
+    providers = [_mock(chain), dead_witness, _mock(chain), _mock(chain)]
+    gw = LightGateway(CHAIN_ID, _opts(chain), providers,
+                      DBStore(MemDB(), CHAIN_ID), config=cfg,
+                      sleep=lambda s: None)
+    dead_witness._lbs.clear()  # witness goes dark after anchor check
+    # first serve: detector drops the dead witness (list now empty)
+    gw.serve_light_block(5, now=t(100))
+    # second serve: ErrNoWitnesses -> a spare rotates into the witness set
+    lb, verdict = gw.serve_light_block(7, now=t(100))
+    assert verdict == VERDICT_FRESH
+    assert lb.hash() == chain[6].hash()
+    assert gw.rotations >= 1
+    assert gw.client.witnesses
+
+
+def test_anchor_lying_witness_evicted_at_construction(keys, chain):
+    # a witness that contradicts the TRUST ANCHOR fails Client.__init__
+    # (compare_first_header_with_witnesses); the gateway must evict it and
+    # rebuild around the rest instead of dying
+    privs, vs = keys
+    fake = gen_chain(12, privs, vs, step_s=20)
+    liar = MockProvider(CHAIN_ID, {lb.height: lb for lb in fake})
+    gw = LightGateway(CHAIN_ID, _opts(chain),
+                      [_mock(chain), liar, _mock(chain)],
+                      DBStore(MemDB(), CHAIN_ID), sleep=lambda s: None)
+    assert gw.scoreboard.evicted("p1")
+    lb, verdict = gw.serve_light_block(6, now=t(100))
+    assert verdict == VERDICT_FRESH
+    assert lb.hash() == chain[5].hash()
+
+
+def test_dead_witness_demoted_not_evicted(chain):
+    dead = MockProvider(CHAIN_ID, {1: chain[0]})
+    gw = LightGateway(CHAIN_ID, _opts(chain),
+                      [_mock(chain), dead, _mock(chain)],
+                      DBStore(MemDB(), CHAIN_ID), sleep=lambda s: None)
+    dead._lbs.clear()  # goes dark after the anchor check
+    lb, _ = gw.serve_light_block(5, now=t(100))
+    assert lb.hash() == chain[4].hash()
+    # unresponsiveness is demotion material, never a permanent eviction
+    assert not gw.scoreboard.evicted("p1")
+    assert gw.scoreboard._board.score("p1") > 0
+
+
+def test_unsubstantiated_lying_witness_evicted(chain):
+    # a witness serving a divergent header it CANNOT substantiate (signed
+    # by foreign keys) is lying: detector drops it, hook evicts it
+    privs_x, vs_x = _mk_keys(4, seed=5)
+    fake = gen_chain(12, privs_x, vs_x, step_s=20)
+    liar = MockProvider(
+        CHAIN_ID, {1: chain[0], **{lb.height: lb for lb in fake[1:]}})
+    gw = LightGateway(CHAIN_ID, _opts(chain),
+                      [_mock(chain), liar, _mock(chain)],
+                      DBStore(MemDB(), CHAIN_ID), sleep=lambda s: None)
+    lb, _ = gw.serve_light_block(6, now=t(100))
+    assert lb.hash() == chain[5].hash()
+    assert gw.scoreboard.evicted("p1")
+    assert gw.client.primary.name == "p0"  # honest primary untouched
+
+
+# --- typed degradation -------------------------------------------------------
+
+
+def test_degraded_refuses_unknown_height_when_providers_dead(chain):
+    providers = [_mock(chain) for _ in range(3)]
+    gw = _gateway(chain, providers=providers)
+    gw.serve_light_block(5, now=t(100))
+    for p in providers:
+        p._lbs.clear()
+    with pytest.raises(Exception) as ei:
+        gw.serve_light_block(11, now=t(150))
+    assert not isinstance(ei.value, AssertionError)
+    assert gw.refused >= 1
+    # but the already-verified height still serves (cache)
+    lb, verdict = gw.serve_light_block(5, now=t(150))
+    assert lb.hash() == chain[4].hash()
+
+
+def test_serve_latest_degrades_to_stale_within_trust_period(chain):
+    providers = [_mock(chain) for _ in range(3)]
+    gw = _gateway(chain, providers=providers)
+    gw.serve_light_block(8, now=t(100))
+    for p in providers:
+        p._lbs.clear()  # provider outage
+    lb, verdict = gw.serve_latest(now=t(200))
+    assert verdict == VERDICT_STALE
+    assert lb.hash() == chain[7].hash()
+    assert gw.stale_served == 1
+
+
+def test_serve_latest_refuses_outside_trust_period(chain):
+    providers = [_mock(chain) for _ in range(3)]
+    gw = _gateway(chain, providers=providers)
+    gw.serve_light_block(8, now=t(100))
+    for p in providers:
+        p._lbs.clear()
+    with pytest.raises(ErrGatewayDegraded):
+        gw.serve_latest(now=t(int(TRUST_PERIOD) + 1000))
+    assert gw.refused >= 1
+
+
+# --- tx plane: refuse-and-repair, never serve-corrupt ------------------------
+
+
+class _QuarantinedIndexer:
+    def get(self, raw):
+        raise CorruptedStoreError("txindex", b"tx/" + raw, "crc mismatch")
+
+
+def test_tx_query_refuses_quarantined_row(chain):
+    gw = _gateway(chain)
+    gw.node = SimpleNamespace(tx_indexer=_QuarantinedIndexer(),
+                              block_store=None)
+    with pytest.raises(ErrGatewayDegraded, match="quarantined"):
+        gw.serve_tx(b"\x01" * 32, now=t(100))
+    assert gw.refused == 1
+
+
+def test_tx_query_without_node_refuses(chain):
+    gw = _gateway(chain)
+    with pytest.raises(ErrGatewayDegraded):
+        gw.serve_tx(b"\x01" * 32)
+
+
+# --- detector thread-safety (shared Client) ----------------------------------
+
+
+def _client_with_lying_witness(keys, chain):
+    privs, vs = keys
+    fake = gen_chain(12, privs, vs, step_s=20)
+    primary = _mock(chain)
+    liar = MockProvider(
+        CHAIN_ID, {1: chain[0], **{lb.height: lb for lb in fake[1:]}})
+    honest = _mock(chain)
+    client = Client(CHAIN_ID, _opts(chain), primary, [liar, honest],
+                    DBStore(MemDB(), CHAIN_ID))
+    return client, liar, honest
+
+
+def test_concurrent_detect_divergence_single_remove_single_record(keys, chain):
+    client, liar, honest = _client_with_lying_witness(keys, chain)
+    target = chain[6]
+    client.verify_light_block  # warm attr
+    # verify through primary only first (no detection) by seeding the store
+    client.trusted_store.save_light_block(chain[2])
+    client.latest_trusted = chain[2]
+
+    unexpected = []
+    conflicts = []
+    barrier = threading.Barrier(2)
+
+    def hammer():
+        try:
+            barrier.wait(timeout=10)
+            detect_divergence(client, target, t(300))
+        except Exception as e:  # noqa: BLE001
+            if type(e).__name__ == "ErrConflictingHeaders":
+                conflicts.append(e)
+            else:
+                unexpected.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    assert not unexpected
+    # the lying witness was removed exactly once, the honest one kept
+    assert all(w is not liar for w in client.witnesses)
+    assert any(w is honest for w in client.witnesses)
+    # exactly one Divergence recorded despite two racing detections
+    assert len(client.divergences) == 1
+
+
+def test_remove_witness_out_of_range_is_tolerated(keys, chain):
+    client, _, _ = _client_with_lying_witness(keys, chain)
+    n = len(client.witnesses)
+    client.remove_witness(99)
+    assert len(client.witnesses) == n
+
+
+def test_remove_witnesses_by_identity_never_double_removes(keys, chain):
+    client, liar, honest = _client_with_lying_witness(keys, chain)
+    client.remove_witnesses([liar, liar, liar])
+    assert len(client.witnesses) == 1
+    assert client.witnesses[0] is honest
+
+
+class _MutatingProvider(MockProvider):
+    """On the first pivot fetch, mutates the client's witness list from
+    another thread (regression: witness-list mutation during an in-flight
+    _verify_skipping must not crash or double-remove)."""
+
+    def __init__(self, chain_id, lbs, client_ref, victim_ref):
+        super().__init__(chain_id, lbs)
+        self.client_ref = client_ref
+        self.victim_ref = victim_ref
+        self.mutated = False
+
+    def light_block(self, height):
+        if not self.mutated and self.client_ref() is not None:
+            self.mutated = True
+            client, victim = self.client_ref(), self.victim_ref()
+            th = threading.Thread(
+                target=client.remove_witnesses, args=([victim, victim],))
+            th.start()
+            th.join(timeout=10)
+        return super().light_block(height)
+
+
+def test_witness_mutation_during_inflight_verify_skipping(keys):
+    # Chain with a validator-set rotation at h4 so skipping 1 -> 6 is forced
+    # to bisect (fetching pivots from the source provider mid-flight).
+    privsA, vsA = keys
+    privsB, vsB = _mk_keys(4, seed=9)
+    lbs = []
+    last_bid = None
+    spec = [(1, vsA, privsA, vsA), (2, vsA, privsA, vsA), (3, vsA, privsA, vsB),
+            (4, vsB, privsB, vsB), (5, vsB, privsB, vsB), (6, vsB, privsB, vsB)]
+    for h, vals, privs, next_vals in spec:
+        header = _mk_header(h, h * 10, vals, next_vals, last_bid)
+        commit = _sign_commit(header, vals, privs)
+        lbs.append(LightBlock(signed_header=SignedHeader(header, commit),
+                              validator_set=vals.copy()))
+        last_bid = commit.block_id
+    by_h = {lb.height: lb for lb in lbs}
+
+    holder = {}
+    source = _MutatingProvider(CHAIN_ID, by_h,
+                               lambda: holder.get("client"),
+                               lambda: holder.get("victim"))
+    w1 = MockProvider(CHAIN_ID, by_h)
+    w2 = MockProvider(CHAIN_ID, by_h)
+    client = Client(
+        CHAIN_ID, TrustOptions(period_s=TRUST_PERIOD, height=1,
+                               hash=lbs[0].hash()),
+        source, [w1, w2], DBStore(MemDB(), CHAIN_ID))
+    holder["client"] = client
+    holder["victim"] = w1
+
+    verified = client._verify_skipping(source, lbs[0], lbs[5], t(100),
+                                       save=False)
+    assert source.mutated
+    assert verified  # bisection actually happened
+    # w1 removed exactly once; w2 untouched
+    assert all(w is not w1 for w in client.witnesses)
+    assert any(w is w2 for w in client.witnesses)
+    assert len(client.witnesses) == 1

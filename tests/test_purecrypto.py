@@ -1,0 +1,110 @@
+"""RFC test vectors for the pure-Python SecretConnection crypto fallback."""
+
+import pytest
+
+from tendermint_tpu.crypto import purecrypto as pc
+
+
+def test_x25519_rfc7748_vector_1():
+    k = bytes.fromhex(
+        "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4"
+    )
+    u = bytes.fromhex(
+        "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c"
+    )
+    out = bytes.fromhex(
+        "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+    )
+    assert pc.x25519(k, u) == out
+
+
+def test_x25519_rfc7748_vector_2():
+    k = bytes.fromhex(
+        "4b66e9d4d1b4673c5ad22691957d6af5c11b6421e0ea01d42ca4169e7918ba0d"
+    )
+    u = bytes.fromhex(
+        "e5210f12786811d3f4b7959d0538ae2c31dbe7106fc03c3efc4cd549c715a493"
+    )
+    out = bytes.fromhex(
+        "95cbde9476e8907d7aade45cb4b873f88b595a68799fa152e6f8f7647aac7957"
+    )
+    assert pc.x25519(k, u) == out
+
+
+def test_x25519_dh_agreement_rfc7748_section_6_1():
+    a = bytes.fromhex(
+        "77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a"
+    )
+    b = bytes.fromhex(
+        "5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb"
+    )
+    pub_a = pc.X25519PrivateKey(a).public_key().public_bytes_raw()
+    pub_b = pc.X25519PrivateKey(b).public_key().public_bytes_raw()
+    assert pub_a == bytes.fromhex(
+        "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a"
+    )
+    assert pub_b == bytes.fromhex(
+        "de9edb7d7b7dc1b4d35b61c2ece435373f8343c85b78674dadfc7e146f882b4f"
+    )
+    shared = bytes.fromhex(
+        "4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742"
+    )
+    ka = pc.X25519PrivateKey(a).exchange(pc.X25519PublicKey(pub_b))
+    kb = pc.X25519PrivateKey(b).exchange(pc.X25519PublicKey(pub_a))
+    assert ka == kb == shared
+
+
+def test_chacha20_rfc8439_keystream_block():
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000090000004a00000000")
+    block = pc.chacha20_xor(key, 1, nonce, b"\x00" * 64)
+    assert block == bytes.fromhex(
+        "10f1e7e4d13b5915500fdd1fa32071c4c7d1f4c733c068030422aa9ac3d46c4e"
+        "d2826446079faa0914c2d705d98b02a2b5129cd1de164eb9cbd083e8a2503c4e"
+    )
+
+
+def test_poly1305_rfc8439_vector():
+    key = bytes.fromhex(
+        "85d6be7857556d337f4452fe42d506a80103808afb0db2fd4abff6af4149f51b"
+    )
+    msg = b"Cryptographic Forum Research Group"
+    assert pc.poly1305_mac(key, msg) == bytes.fromhex(
+        "a8061dc1305136c6c22b8baf0c0127a9"
+    )
+
+
+def test_aead_rfc8439_vector():
+    key = bytes.fromhex(
+        "808182838485868788898a8b8c8d8e8f909192939495969798999a9b9c9d9e9f"
+    )
+    nonce = bytes.fromhex("070000004041424344454647")
+    aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    plaintext = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    aead = pc.ChaCha20Poly1305(key)
+    sealed = aead.encrypt(nonce, plaintext, aad)
+    assert sealed[-16:] == bytes.fromhex("1ae10b594f09e26a7e902ecbd0600691")
+    assert sealed[:32] == bytes.fromhex(
+        "d31a8d34648e60db7b86afbc53ef7ec2a4aded51296e08fea9e2b5a736ee62d6"
+    )
+    assert aead.decrypt(nonce, sealed, aad) == plaintext
+
+
+def test_aead_rejects_tampering():
+    aead = pc.ChaCha20Poly1305(b"\x01" * 32)
+    sealed = bytearray(aead.encrypt(b"\x00" * 12, b"payload", None))
+    sealed[0] ^= 0xFF
+    with pytest.raises(pc.InvalidTag):
+        aead.decrypt(b"\x00" * 12, bytes(sealed), None)
+
+
+def test_secret_connection_uses_fallback_cleanly():
+    # The import seam in p2p/secret_connection.py must resolve whether or
+    # not `cryptography` is installed.
+    from tendermint_tpu.p2p import secret_connection as sc
+
+    assert hasattr(sc, "ChaCha20Poly1305")
+    assert hasattr(sc, "X25519PrivateKey")

@@ -153,6 +153,29 @@ def test_rpc_surface(tmp_path):
             raise AssertionError("expected ErrHeightTooHigh")
         except ErrHeightTooHigh:
             pass
+
+        # gateway routes: verified-or-refused plane over the same store
+        g1 = _rpc(base, "gateway_light_block", {"height": 1})
+        assert g1["light_block"] == lb_res["light_block"]
+        assert g1["verdict"] in ("fresh", "cached")
+        assert _rpc(base, "gateway_light_block", {"height": 1})["verdict"] == "cached"
+        # height=0 (latest): the test chain is timestamped at genesis_time
+        # (2023) which is past the trust period by real wall clock, so the
+        # gateway must REFUSE with a typed degradation rather than serve.
+        try:
+            _rpc(base, "gateway_light_block", {"height": 0})
+            raise AssertionError("expected gateway degraded refusal")
+        except RuntimeError as e:
+            assert "gateway degraded" in str(e)
+        try:
+            _rpc(base, "gateway_light_block", {"height": 10_000})
+            raise AssertionError("expected height-too-high error")
+        except RuntimeError as e:
+            assert "must be less" in str(e)
+        gs = _rpc(base, "gateway_status")
+        assert gs["primary"] == "local"
+        assert gs["counters"]["queries"] >= 3
+        assert gs["counters"]["cache_hits"] >= 1
     finally:
         node.stop()
 
